@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Benchmark harness — prints ONE JSON line with the headline metric.
+
+Metric: training throughput in samples/s on the visible TPU chip(s),
+matching the reference's end-of-run report (alexnet.cc:129-130).  Default
+workload is the BASELINE.json north-star CNN (InceptionV3 when available,
+else AlexNet), synthetic data, fused jitted train step.
+
+``vs_baseline`` compares per-chip samples/s against a published-class A100
+per-chip figure for the same model (BASELINE.md: the reference repo itself
+publishes no numbers; the north star is ">=1x per-chip A100 samples/sec").
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+# A100 per-chip training throughput reference points (public benchmark
+# class numbers, mixed precision): used only for the vs_baseline ratio.
+A100_SAMPLES_PER_SEC = {
+    "inception_v3": 1600.0,
+    "alexnet": 5000.0,
+}
+
+
+def build(model_name: str, batch_size: int):
+    import flexflow_tpu as ff
+
+    cfg = ff.FFConfig(batch_size=batch_size, compute_dtype="bfloat16")
+    if model_name == "inception_v3":
+        from flexflow_tpu.models.inception import build_inception_v3
+        model, inp, logits = build_inception_v3(cfg, num_classes=1000,
+                                                image_size=299)
+    else:
+        from flexflow_tpu.models.alexnet import build_alexnet
+        model, inp, logits = build_alexnet(cfg, num_classes=1000)
+    model.compile(ff.SGDOptimizer(lr=0.01),
+                  ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [], final_tensor=logits)
+    model.init_layers(seed=0)
+    shape = inp.shape
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal(shape, dtype=np.float32)
+    y = rng.integers(0, 1000, (shape[0], 1)).astype(np.int32)
+    return model, x, y
+
+
+def main():
+    model_name = "inception_v3"
+    batch_size = 128
+    for i, a in enumerate(sys.argv):
+        if a == "--model":
+            model_name = sys.argv[i + 1]
+        if a == "--batch":
+            batch_size = int(sys.argv[i + 1])
+    try:
+        model, x, y = build(model_name, batch_size)
+    except ImportError:
+        model_name = "alexnet"
+        model, x, y = build(model_name, batch_size)
+
+    import jax
+    n_chips = len(jax.devices())
+    # warmup / compile
+    for _ in range(3):
+        loss = model.train_batch(x, y)
+    jax.block_until_ready(model._params)
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        model.train_batch(x, y)
+    jax.block_until_ready(model._params)
+    dt = time.perf_counter() - t0
+    sps = batch_size * iters / dt
+    per_chip = sps / max(1, n_chips)
+    base = A100_SAMPLES_PER_SEC.get(model_name, 1.0)
+    print(json.dumps({
+        "metric": f"{model_name}_train_samples_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "samples/s/chip",
+        "vs_baseline": round(per_chip / base, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""flexflow_tpu — a TPU-native distributed DNN training framework.
+
+A ground-up re-design of FlexFlow (MLSys'19; reference at /root/reference)
+for TPUs: the operator set, FFModel graph API, SOAP parallelization-strategy
+search, and training runtime are rebuilt on jax/XLA — Legion tasks become one
+fused SPMD XLA program, Legion partitions become ``jax.sharding`` named-mesh
+annotations, Legion DMA/GASNet become ICI/DCN collectives emitted by GSPMD,
+and the CUDA/cuDNN kernels become XLA HLO (+ Pallas for the hot paths).
+"""
+
+from . import losses, metrics
+from .config import (CompMode, DeviceType, FFConfig, MemoryType,
+                     ParallelConfig)
+from .initializers import (ConstantInitializer, GlorotUniform,
+                           NormInitializer, UniformInitializer,
+                           ZeroInitializer)
+from .metrics import PerfMetrics
+from .model import FFModel
+from .op import Op, OpType
+from .optimizers import AdamOptimizer, Optimizer, SGDOptimizer
+from .parallel.mesh import MachineMesh
+from .tensor import Parameter, Tensor
+
+__version__ = "0.1.0"
+
+LOSS_SPARSE_CATEGORICAL_CROSSENTROPY = losses.SPARSE_CATEGORICAL_CROSSENTROPY
+LOSS_CATEGORICAL_CROSSENTROPY = losses.CATEGORICAL_CROSSENTROPY
+LOSS_MEAN_SQUARED_ERROR = losses.MEAN_SQUARED_ERROR
+METRICS_ACCURACY = metrics.ACCURACY
+METRICS_SPARSE_CATEGORICAL_CROSSENTROPY = metrics.SPARSE_CATEGORICAL_CROSSENTROPY
+METRICS_CATEGORICAL_CROSSENTROPY = metrics.CATEGORICAL_CROSSENTROPY
+METRICS_MEAN_SQUARED_ERROR = metrics.MEAN_SQUARED_ERROR

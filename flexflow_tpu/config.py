@@ -1,0 +1,194 @@
+"""FFConfig / ParallelConfig — run configuration and the strategy atom.
+
+TPU-native re-design of the reference's ``include/config.h`` (FFConfig,
+ParallelConfig; defaults in ``src/runtime/model.cc:1182-1219``; CLI parser
+``model.cc:1221-1289``).  The reference counts CUDA GPUs per node
+(``-ll:gpu``); here the worker unit is a TPU chip in a ``jax`` device mesh
+(``-ll:tpu``, with ``-ll:gpu`` accepted as a compatibility alias).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, List, Optional, Sequence, Tuple
+
+MAX_TENSOR_DIM = 4  # logical graph dims, matching reference config.h:30
+MAX_SEQ_DIM = 1
+
+
+class DeviceType(enum.IntEnum):
+    """Mirrors strategy.proto's Op.DeviceType (GPU=0, CPU=1).
+
+    On TPU the accelerator slot is the TPU chip; ``DEVICE`` keeps the
+    wire-format value 0 so existing strategy files parse unchanged.  ``HOST``
+    (=CPU) marks ops placed on the host — the reference uses this for DLRM
+    embedding tables (``dlrm_strategy_hetero.cc``); we map it to host-memory
+    offload.
+    """
+
+    DEVICE = 0  # accelerator (TPU chip); reference: GPU
+    HOST = 1    # host CPU
+
+    # aliases for reference-parity spelling
+    GPU = 0
+    CPU = 1
+    TPU = 0
+
+
+class MemoryType(enum.IntEnum):
+    """Mirrors strategy.proto Op.MemoryType: FBM (device HBM) / ZCM (host)."""
+
+    FBM = 0  # device framebuffer -> TPU HBM
+    ZCM = 1  # zero-copy (host-pinned) -> host memory
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelConfig:
+    """The SOAP strategy atom (reference ``config.h:42-51``).
+
+    ``dims[i]`` is the partition degree of logical tensor dim ``i`` of the
+    op's *output* tensor, ordered outermost-first (sample dim first) —
+    note the reference stores ``adim`` innermost-first; we use natural
+    (row-major, sample-major) order throughout and convert at the strategy
+    file boundary.
+
+    ``device_ids`` enumerates the flat mesh coordinates owning each part
+    (row-major over ``dims``).  On TPU, device ids index into the flattened
+    ``jax`` device mesh rather than Legion processor lists.
+    """
+
+    device_type: DeviceType = DeviceType.DEVICE
+    dims: Tuple[int, ...] = (1,)
+    device_ids: Tuple[int, ...] = (0,)
+    memory_types: Tuple[MemoryType, ...] = ()
+
+    @property
+    def num_parts(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+    def with_dims(self, dims: Sequence[int]) -> "ParallelConfig":
+        nparts = 1
+        for d in dims:
+            nparts *= d
+        return ParallelConfig(
+            device_type=self.device_type,
+            dims=tuple(int(d) for d in dims),
+            device_ids=tuple(range(nparts)),
+            memory_types=self.memory_types,
+        )
+
+    @staticmethod
+    def data_parallel(num_parts: int, ndims: int = 2) -> "ParallelConfig":
+        """Reference ``Op::get_data_parallel_config`` (model.cc:263-274):
+        partition only the sample (outermost) dim."""
+        dims = (num_parts,) + (1,) * (ndims - 1)
+        return ParallelConfig(
+            device_type=DeviceType.DEVICE,
+            dims=dims,
+            device_ids=tuple(range(num_parts)),
+        )
+
+
+class CompMode(enum.Enum):
+    TRAINING = "training"
+    INFERENCE = "inference"
+
+
+@dataclasses.dataclass
+class FFConfig:
+    """Run configuration (reference ``config.h:66-103``).
+
+    Reference defaults from ``model.cc:1182-1197``: epochs=1, batchSize=64,
+    lr=0.01, wd=0.0001, workersPerNode=0, numNodes=1, search_budget=0,
+    search_alpha=0.05, profiling off.
+    """
+
+    epochs: int = 1
+    batch_size: int = 64
+    learning_rate: float = 0.01
+    weight_decay: float = 1e-4
+    workers_per_node: int = 0   # -ll:tpu — chips per host; 0 = all visible
+    cpus_per_node: int = 1      # -ll:cpu
+    num_nodes: int = 1          # --nodes
+    profiling: bool = False
+    # strategy search knobs (reference model.cc:1253-1260)
+    search_budget: int = 0      # --budget: MCMC iterations
+    search_alpha: float = 0.05  # --alpha: annealing temperature
+    search_overlap_backward_update: bool = False
+    import_strategy_file: str = ""
+    export_strategy_file: str = ""
+    # TPU-native additions
+    dataset_path: str = ""
+    seed: int = 0
+    compute_dtype: str = "bfloat16"  # MXU-native compute dtype
+    param_dtype: str = "float32"
+    mesh_shape: Optional[Dict[str, int]] = None  # explicit mesh override
+    simulator_mode: str = "analytic"  # "analytic" | "measure"
+    remat: bool = False  # jax.checkpoint the forward pass
+
+    # resolved at FFModel construction
+    strategies: Dict[str, ParallelConfig] = dataclasses.field(default_factory=dict)
+
+    @property
+    def num_devices(self) -> int:
+        return max(1, self.workers_per_node) * self.num_nodes
+
+    @staticmethod
+    def parse_args(argv: Optional[List[str]] = None) -> "FFConfig":
+        """CLI parser with the reference's flag set (model.cc:1221-1289):
+        ``-e/--epochs -b/--batch-size --lr/--learning-rate --wd/--weight-decay
+        -p/--print-freq -d/--dataset --budget --alpha -s/--export -import/
+        --import -ll:tpu -ll:gpu -ll:cpu --nodes --profiling --overlap``."""
+        import sys
+
+        if argv is None:
+            argv = sys.argv[1:]
+        cfg = FFConfig()
+        i = 0
+        while i < len(argv):
+            a = argv[i]
+
+            def val() -> str:
+                nonlocal i
+                i += 1
+                return argv[i]
+
+            if a in ("-e", "--epochs"):
+                cfg.epochs = int(val())
+            elif a in ("-b", "--batch-size"):
+                cfg.batch_size = int(val())
+            elif a in ("--lr", "--learning-rate"):
+                cfg.learning_rate = float(val())
+            elif a in ("--wd", "--weight-decay"):
+                cfg.weight_decay = float(val())
+            elif a in ("-d", "--dataset"):
+                cfg.dataset_path = val()
+            elif a == "--budget":
+                cfg.search_budget = int(val())
+            elif a == "--alpha":
+                cfg.search_alpha = float(val())
+            elif a == "--overlap":
+                cfg.search_overlap_backward_update = True
+            elif a in ("-s", "--export"):
+                cfg.export_strategy_file = val()
+            elif a in ("-import", "--import"):
+                cfg.import_strategy_file = val()
+            elif a in ("-ll:tpu", "-ll:gpu"):
+                cfg.workers_per_node = int(val())
+            elif a == "-ll:cpu":
+                cfg.cpus_per_node = int(val())
+            elif a == "--nodes":
+                cfg.num_nodes = int(val())
+            elif a == "--profiling":
+                cfg.profiling = True
+            elif a == "--seed":
+                cfg.seed = int(val())
+            elif a == "--remat":
+                cfg.remat = True
+            # unknown flags pass through (reference forwards Legion flags)
+            i += 1
+        return cfg

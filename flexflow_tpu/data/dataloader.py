@@ -1,0 +1,95 @@
+"""Data loading (reference ``python/flexflow_dataloader.{cc,cu}``).
+
+The reference loads the whole dataset into zero-copy host memory with CPU
+tasks, then per-iteration index-launches GPU copy tasks over the batch
+partition (flexflow_dataloader.cc:260-330).  TPU-native: the dataset lives in
+host numpy; ``next_batch`` device_puts the batch with the mesh's batch
+sharding — each chip receives only its shard over PCIe/ICI, which is the
+zero-copy -> FB copy path.  Synthetic (random) data is the default, matching
+the reference's no-dataset smoke mode (README.md:44, alexnet.cc:152-155).
+
+A C++ prefetching loader (flexflow_tpu/native) can be slotted in for real
+datasets; the Python loader is the reference-parity surface.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def synthetic_dataset(num_samples: int, input_shapes: Sequence[Tuple[int, ...]],
+                      label_shape: Tuple[int, ...], num_classes: int = 10,
+                      seed: int = 0, input_dtypes: Optional[Sequence[str]] = None,
+                      label_dtype: str = "int32"):
+    """Random dataset (reference generates random data when ``-d`` unset)."""
+    rng = np.random.default_rng(seed)
+    xs = []
+    for i, shape in enumerate(input_shapes):
+        dt = (input_dtypes[i] if input_dtypes else "float32")
+        if np.issubdtype(np.dtype(dt), np.integer):
+            xs.append(rng.integers(0, num_classes,
+                                   (num_samples,) + tuple(shape)).astype(dt))
+        else:
+            xs.append(rng.standard_normal(
+                (num_samples,) + tuple(shape), dtype=np.float32).astype(dt))
+    if np.issubdtype(np.dtype(label_dtype), np.integer):
+        y = rng.integers(0, num_classes,
+                         (num_samples,) + tuple(label_shape)).astype(label_dtype)
+    else:
+        y = rng.standard_normal(
+            (num_samples,) + tuple(label_shape), dtype=np.float32)
+    return xs, y
+
+
+class SingleDataLoader:
+    """Reference SingleDataLoader: one full tensor held host-side, batched."""
+
+    def __init__(self, model, input_tensor, data: np.ndarray,
+                 batch_size: Optional[int] = None):
+        self.model = model
+        self.tensor = input_tensor
+        self.data = data
+        self.batch_size = batch_size or model.config.batch_size
+        self.num_samples = data.shape[0]
+        self.next_index = 0
+
+    def reset(self) -> None:
+        self.next_index = 0
+
+    def next_batch(self) -> np.ndarray:
+        i = self.next_index
+        self.next_index += self.batch_size
+        return self.data[i:i + self.batch_size]
+
+
+class DataLoader:
+    """Multi-input loader mirroring the reference app DataLoaders
+    (e.g. alexnet.cc DataLoader: full dataset + per-iteration next_batch)."""
+
+    def __init__(self, model, inputs_data: Sequence[np.ndarray],
+                 labels: np.ndarray, batch_size: Optional[int] = None):
+        self.model = model
+        self.inputs_data = [np.asarray(a) for a in inputs_data]
+        self.labels = np.asarray(labels)
+        self.batch_size = batch_size or model.config.batch_size
+        self.num_samples = self.labels.shape[0]
+        self.next_index = 0
+
+    def reset(self) -> None:
+        self.next_index = 0
+
+    def next_batch(self, model=None) -> None:
+        """Load the next batch into the model (reference
+        ``data_loader.next_batch(ff)``)."""
+        model = model or self.model
+        i = self.next_index
+        bs = self.batch_size
+        if i + bs > self.num_samples:
+            i = 0
+            self.next_index = 0
+        self.next_index = i + bs
+        arrays = [a[i:i + bs] for a in self.inputs_data]
+        arrays.append(self.labels[i:i + bs])
+        model.set_batch(*arrays)

@@ -1,0 +1,78 @@
+"""Weight initializers (reference ``include/initializer.h:26-100``,
+``src/runtime/initializer_kernel.cu``).
+
+The reference launches one cuRAND task per parameter partition; here each
+initializer is a pure function of a ``jax.random`` key — XLA generates the
+values directly on device, sharded like the parameter, so multi-chip init
+needs no host transfer at all.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Initializer:
+    def __call__(self, key: jax.Array, shape: Tuple[int, ...], dtype) -> jax.Array:
+        raise NotImplementedError
+
+
+class GlorotUniform(Initializer):
+    """Xavier/Glorot uniform.  Fan computation mirrors
+    ``initializer_kernel.cu:50-126``: for 4-D conv weights (O,I,H,W)
+    receptive = H*W, fan_in = I*receptive, fan_out = O*receptive; for 2-D
+    (out,in) fan_in=in, fan_out=out."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+
+    def __call__(self, key, shape, dtype):
+        if len(shape) == 4:
+            o, i, h, w = shape
+            receptive = h * w
+            fan_in, fan_out = i * receptive, o * receptive
+        elif len(shape) == 2:
+            fan_in, fan_out = shape[1], shape[0]
+        else:
+            fan_in = fan_out = int(np.prod(shape)) // max(1, shape[0])
+        scale = float(np.sqrt(6.0 / (fan_in + fan_out)))
+        return jax.random.uniform(key, shape, dtype, minval=-scale, maxval=scale)
+
+
+class ZeroInitializer(Initializer):
+    """Reference ZeroInitializer (GPU + CPU variants, initializer.cc)."""
+
+    def __call__(self, key, shape, dtype):
+        return jnp.zeros(shape, dtype)
+
+
+class ConstantInitializer(Initializer):
+    def __init__(self, value: float):
+        self.value = value
+
+    def __call__(self, key, shape, dtype):
+        return jnp.full(shape, self.value, dtype)
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, seed: int = 0, minv: float = 0.0, maxv: float = 1.0):
+        self.seed, self.minv, self.maxv = seed, minv, maxv
+
+    def __call__(self, key, shape, dtype):
+        return jax.random.uniform(key, shape, dtype, minval=self.minv, maxval=self.maxv)
+
+
+class NormInitializer(Initializer):
+    def __init__(self, seed: int = 0, mean: float = 0.0, stddev: float = 1.0):
+        self.seed, self.mean, self.stddev = seed, mean, stddev
+
+    def __call__(self, key, shape, dtype):
+        return self.mean + self.stddev * jax.random.normal(key, shape, dtype)
+
+
+# keras-style aliases
+GlorotUniformInitializer = GlorotUniform

@@ -1,0 +1,77 @@
+"""Loss functions (reference ``src/loss_functions/loss_functions.cu``,
+``include/loss_functions.h:27-42``).
+
+Reference contract: the loss *task* seeds logit gradients directly — for
+sparse-CCE it copies the softmax output and subtracts 1 at the label index,
+scaling by 1/batch (loss_functions.cu:36-74).  TPU-native: each loss is a
+scalar-valued pure function; ``jax.grad`` of the fused
+``softmax_cross_entropy(logits)`` produces exactly that seeded gradient
+(softmax - onehot)/batch, so the hand-written kernels collapse into autodiff
+identities.  Losses reduce in float32.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+SPARSE_CATEGORICAL_CROSSENTROPY = "sparse_categorical_crossentropy"
+CATEGORICAL_CROSSENTROPY = "categorical_crossentropy"
+MEAN_SQUARED_ERROR = "mean_squared_error"
+MEAN_SQUARED_ERROR_AVG_REDUCE = "mean_squared_error_avg_reduce"
+MEAN_SQUARED_ERROR_SUM_REDUCE = "mean_squared_error_sum_reduce"
+IDENTITY = "identity"
+
+
+def sparse_categorical_crossentropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Fused log-softmax CE on *logits* (see Softmax-parity note in
+    flexflow_tpu/ops/tensor_ops.py).  labels: int (batch,) or (batch,1)."""
+    labels = labels.reshape(labels.shape[0]).astype(jnp.int32)
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    ll = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return jnp.mean(logz - ll)
+
+
+def categorical_crossentropy(probs: jax.Array, labels: jax.Array) -> jax.Array:
+    """CCE against one-hot/probability labels (loss_functions.cu:50-60)."""
+    probs = probs.astype(jnp.float32)
+    eps = 1e-8
+    return -jnp.mean(jnp.sum(labels * jnp.log(probs + eps), axis=-1))
+
+
+def mean_squared_error(preds: jax.Array, labels: jax.Array) -> jax.Array:
+    d = preds.astype(jnp.float32) - labels.astype(jnp.float32)
+    return jnp.mean(jnp.sum(jnp.square(d), axis=tuple(range(1, d.ndim))))
+
+
+_LOSSES = {
+    SPARSE_CATEGORICAL_CROSSENTROPY: sparse_categorical_crossentropy,
+    CATEGORICAL_CROSSENTROPY: categorical_crossentropy,
+    MEAN_SQUARED_ERROR: mean_squared_error,
+    MEAN_SQUARED_ERROR_AVG_REDUCE: lambda p, l: jnp.mean(
+        jnp.square(p.astype(jnp.float32) - l.astype(jnp.float32))),
+    MEAN_SQUARED_ERROR_SUM_REDUCE: lambda p, l: jnp.sum(
+        jnp.square(p.astype(jnp.float32) - l.astype(jnp.float32))),
+}
+
+
+def get_loss_fn(loss_type: str):
+    # keras-style aliases
+    alias = {
+        "sparse_crossentropy": SPARSE_CATEGORICAL_CROSSENTROPY,
+        "scce": SPARSE_CATEGORICAL_CROSSENTROPY,
+        "cce": CATEGORICAL_CROSSENTROPY,
+        "mse": MEAN_SQUARED_ERROR,
+    }
+    loss_type = alias.get(loss_type, loss_type)
+    if loss_type not in _LOSSES:
+        raise ValueError(f"unknown loss {loss_type!r}")
+    return _LOSSES[loss_type]
+
+
+def uses_logits(loss_type: str) -> bool:
+    """Sparse-CCE consumes raw logits (fused softmax path); CCE/MSE consume
+    the final op's output as-is."""
+    return loss_type in (SPARSE_CATEGORICAL_CROSSENTROPY, "sparse_crossentropy",
+                         "scce")

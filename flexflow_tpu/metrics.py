@@ -1,0 +1,108 @@
+"""Metrics / PerfMetrics (reference ``src/metrics_functions/``,
+``include/metrics_functions.h:25-57``).
+
+The reference reduces per-batch metrics on-GPU into a ``PerfMetrics`` struct
+returned as a Legion future, folded across iterations by a CPU task
+(model.cc:1092-1114).  TPU-native: the metric computation is part of the
+jitted step (a psum-style reduction XLA fuses in); the fold across iterations
+is a tiny host-side accumulator identical in spirit to UPDATE_METRICS_TASK.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+ACCURACY = "accuracy"
+CATEGORICAL_CROSSENTROPY = "categorical_crossentropy"
+SPARSE_CATEGORICAL_CROSSENTROPY = "sparse_categorical_crossentropy"
+MEAN_SQUARED_ERROR = "mean_squared_error"
+ROOT_MEAN_SQUARED_ERROR = "root_mean_squared_error"
+MEAN_ABSOLUTE_ERROR = "mean_absolute_error"
+
+
+@dataclasses.dataclass
+class PerfMetrics:
+    """Host-side fold of per-iteration metric sums (reference
+    metrics_functions.h:25-44: train_all, train_correct, cce_loss, sparse_cce,
+    mse, rmse, mae)."""
+
+    train_all: int = 0
+    train_correct: int = 0
+    cce_loss: float = 0.0
+    sparse_cce_loss: float = 0.0
+    mse_loss: float = 0.0
+    rmse_loss: float = 0.0
+    mae_loss: float = 0.0
+
+    def update(self, batch_sums: Dict[str, jax.Array]) -> None:
+        self.train_all += int(batch_sums.get("count", 0))
+        self.train_correct += int(batch_sums.get("correct", 0))
+        self.cce_loss += float(batch_sums.get("cce", 0.0))
+        self.sparse_cce_loss += float(batch_sums.get("scce", 0.0))
+        self.mse_loss += float(batch_sums.get("mse", 0.0))
+        self.rmse_loss += float(batch_sums.get("rmse", 0.0))
+        self.mae_loss += float(batch_sums.get("mae", 0.0))
+
+    @property
+    def accuracy(self) -> float:
+        return self.train_correct / max(1, self.train_all)
+
+    def report(self, metrics: Sequence[str]) -> str:
+        """Format like metrics_functions.cc:59-86."""
+        parts = []
+        n = max(1, self.train_all)
+        if ACCURACY in metrics:
+            parts.append(
+                f"accuracy: {100.0 * self.accuracy:.2f}% "
+                f"({self.train_correct} / {self.train_all})")
+        if CATEGORICAL_CROSSENTROPY in metrics:
+            parts.append(f"cce_loss: {self.cce_loss / n:.6f}")
+        if SPARSE_CATEGORICAL_CROSSENTROPY in metrics:
+            parts.append(f"sparse_cce_loss: {self.sparse_cce_loss / n:.6f}")
+        if MEAN_SQUARED_ERROR in metrics:
+            parts.append(f"mse_loss: {self.mse_loss / n:.6f}")
+        if ROOT_MEAN_SQUARED_ERROR in metrics:
+            parts.append(f"rmse_loss: {self.rmse_loss / n:.6f}")
+        if MEAN_ABSOLUTE_ERROR in metrics:
+            parts.append(f"mae_loss: {self.mae_loss / n:.6f}")
+        return "  ".join(parts)
+
+
+def compute_batch_metrics(preds: jax.Array, labels: jax.Array,
+                          metric_names: Sequence[str],
+                          loss_type: str) -> Dict[str, jax.Array]:
+    """Per-batch metric *sums* (not means) so the host fold matches the
+    reference's accumulate-then-divide semantics
+    (metrics_functions.cu:58-160)."""
+    out: Dict[str, jax.Array] = {"count": jnp.asarray(preds.shape[0], jnp.int32)}
+    pf = preds.astype(jnp.float32)
+    for m in metric_names:
+        if m == ACCURACY:
+            if labels.ndim == 1 or labels.shape[-1] == 1:
+                lab = labels.reshape(labels.shape[0]).astype(jnp.int32)
+                pred_cls = jnp.argmax(pf, axis=-1).astype(jnp.int32)
+                out["correct"] = jnp.sum(pred_cls == lab).astype(jnp.int32)
+            else:
+                out["correct"] = jnp.sum(
+                    jnp.argmax(pf, -1) == jnp.argmax(labels, -1)).astype(jnp.int32)
+        elif m == SPARSE_CATEGORICAL_CROSSENTROPY:
+            lab = labels.reshape(labels.shape[0]).astype(jnp.int32)
+            logp = jax.nn.log_softmax(pf, axis=-1)
+            out["scce"] = -jnp.sum(
+                jnp.take_along_axis(logp, lab[:, None], axis=-1))
+        elif m == CATEGORICAL_CROSSENTROPY:
+            out["cce"] = -jnp.sum(labels * jnp.log(pf + 1e-8))
+        elif m == MEAN_SQUARED_ERROR:
+            out["mse"] = jnp.sum(
+                jnp.mean(jnp.square(pf - labels), axis=tuple(range(1, pf.ndim))))
+        elif m == ROOT_MEAN_SQUARED_ERROR:
+            out["rmse"] = jnp.sum(jnp.sqrt(
+                jnp.mean(jnp.square(pf - labels), axis=tuple(range(1, pf.ndim)))))
+        elif m == MEAN_ABSOLUTE_ERROR:
+            out["mae"] = jnp.sum(
+                jnp.mean(jnp.abs(pf - labels), axis=tuple(range(1, pf.ndim))))
+    return out

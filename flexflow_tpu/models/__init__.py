@@ -1,0 +1,1 @@
+from .alexnet import build_alexnet

@@ -1,0 +1,123 @@
+"""Op — abstract operator base (reference ``include/model.h:190-230``).
+
+A reference Op owns Legion task implementations (init/forward/backward) plus
+partition builders and an on-GPU ``measure_compute_time`` hook.  The TPU-native
+Op is much thinner by design:
+
+* ``forward(params, inputs, ctx)`` is a *pure jax function*; backward comes
+  from autodiff (``jax.grad``) instead of hand-written backward tasks, and
+  gradient accumulation over replicas is XLA's psum instead of the enlarged
+  grad-region trick (reference ``optimizer_kernel.cu:168-179``).
+* partitioning is declarative: ``parallel_dims()`` names which output dims a
+  strategy may split (the SOAP legality predicate, replacing the per-op
+  asserts like conv_2d.cu:201's ``num_par_c==1``), and the resolved
+  ParallelConfig turns into a ``jax.sharding`` PartitionSpec constraint rather
+  than a Legion partition tree.
+* ``flops()``/``bytes()`` feed the analytic simulator (replacing the
+  on-hardware ``measure_compute_time`` as default; a measure mode still
+  exists in flexflow_tpu/search/simulator.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from .config import ParallelConfig
+from .tensor import Parameter, Tensor
+
+
+class OpType(enum.Enum):
+    CONV2D = "conv2d"
+    POOL2D = "pool2d"
+    LINEAR = "linear"
+    EMBEDDING = "embedding"
+    FLAT = "flat"
+    SOFTMAX = "softmax"
+    CONCAT = "concat"
+    SPLIT = "split"
+    RESHAPE = "reshape"
+    TRANSPOSE = "transpose"
+    DROPOUT = "dropout"
+    BATCHNORM = "batchnorm"
+    LAYERNORM = "layernorm"
+    RMSNORM = "rmsnorm"
+    ELEMENT_UNARY = "element_unary"
+    ELEMENT_BINARY = "element_binary"
+    MSELOSS = "mse_loss"
+    ATTENTION = "attention"
+    LSTM = "lstm"
+    INPUT = "input"
+
+
+@dataclasses.dataclass
+class OpContext:
+    """Per-trace execution context threaded through op forward functions."""
+
+    training: bool = True
+    rng: Optional[jax.Array] = None
+    compute_dtype: str = "bfloat16"
+    mesh: Optional[object] = None  # MachineMesh when compiled multi-chip
+    # functional state updates: ops write {param_name: new_value} here for
+    # non-trainable state (batchnorm running stats); the train step returns
+    # them as part of the new params pytree
+    updates: Dict[str, jax.Array] = dataclasses.field(default_factory=dict)
+
+
+class Op:
+    """Base operator.  Subclasses set ``op_type`` and implement ``forward``."""
+
+    op_type: OpType = OpType.INPUT
+
+    def __init__(self, name: str, inputs: Sequence[Tensor]):
+        self.name = name
+        self.inputs: List[Tensor] = list(inputs)
+        self.outputs: List[Tensor] = []
+        self.weights: List[Parameter] = []
+        # resolved strategy (set by FFModel.compile)
+        self.parallel_config: Optional[ParallelConfig] = None
+
+    # --- graph construction helpers -------------------------------------
+    def _add_output(self, shape, dtype="float32", idx: int = 0) -> Tensor:
+        t = Tensor(shape=tuple(int(s) for s in shape), dtype=dtype,
+                   name=f"{self.name}:out{idx}", owner_op=self, owner_idx=idx)
+        self.outputs.append(t)
+        return t
+
+    def _add_weight(self, shape, initializer, name: str, dtype="float32",
+                    sharded_dim: Optional[int] = None,
+                    trainable: bool = True) -> Parameter:
+        p = Parameter(shape=tuple(int(s) for s in shape), dtype=dtype,
+                      name=f"{self.name}/{name}", pcname=self.name,
+                      initializer=initializer, sharded_dim=sharded_dim,
+                      trainable=trainable)
+        self.weights.append(p)
+        return p
+
+    # --- execution ------------------------------------------------------
+    def forward(self, params: Dict[str, jax.Array], inputs: List[jax.Array],
+                ctx: OpContext) -> List[jax.Array]:
+        raise NotImplementedError
+
+    # --- SOAP legality & cost model -------------------------------------
+    def parallel_dims(self) -> Tuple[bool, ...]:
+        """Which output dims may be partitioned.  Default: sample dim only
+        (the reference default strategy, model.cc:263-274)."""
+        nd = self.outputs[0].num_dims if self.outputs else 1
+        return (True,) + (False,) * (nd - 1)
+
+    def flops(self) -> int:
+        """Forward FLOPs for the whole (unpartitioned) op."""
+        return 2 * self.outputs[0].volume if self.outputs else 0
+
+    def weight_bytes(self) -> int:
+        return sum(w.volume * 4 for w in self.weights)
+
+    def activation_bytes(self) -> int:
+        return sum(t.volume * 4 for t in self.outputs)
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}(name={self.name!r})"

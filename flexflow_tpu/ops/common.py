@@ -1,0 +1,45 @@
+"""Shared op helpers: dtype policy and activation epilogues.
+
+MXU policy: matmuls/convs run in the configured compute dtype (bfloat16 by
+default) with float32 accumulation (``preferred_element_type``); parameters
+stay float32.  The reference's analogue is cuDNN/cuBLAS float32 throughout —
+bf16+f32-accumulate is the TPU-native equivalent contract.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cast_compute(x: jax.Array, ctx) -> jax.Array:
+    dt = jnp.dtype(ctx.compute_dtype)
+    if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != dt:
+        return x.astype(dt)
+    return x
+
+
+def apply_activation(x: jax.Array, activation):
+    """Fused activation epilogue (reference fuses ReLU into cuDNN conv/linear
+    descriptors, conv_2d.cu:343-346; XLA fuses these automatically)."""
+    if activation is None or activation == "none":
+        return x
+    if activation == "relu":
+        return jax.nn.relu(x)
+    if activation == "sigmoid":
+        return jax.nn.sigmoid(x)
+    if activation == "tanh":
+        return jnp.tanh(x)
+    if activation == "elu":
+        return jax.nn.elu(x)
+    if activation == "gelu":
+        return jax.nn.gelu(x)
+    if activation == "exp":
+        return jnp.exp(x)
+    if activation == "silu":
+        return jax.nn.silu(x)
+    if activation == "softmax":
+        return jax.nn.softmax(x, axis=-1)
+    if callable(activation):
+        return activation(x)
+    raise ValueError(f"unknown activation {activation!r}")

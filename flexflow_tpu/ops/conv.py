@@ -1,0 +1,123 @@
+"""Conv2D / Pool2D (reference ``src/ops/conv_2d.cu``, ``src/ops/pool_2d.cu``).
+
+The reference wraps cuDNN with autotuned algorithms and optional fused ReLU
+(conv_2d.cu:343-346, 413-417).  Here Conv2D is a single
+``lax.conv_general_dilated`` — XLA tiles it onto the MXU and fuses the bias
+add + activation epilogue, so the cuDNN "fused relu" path is the default
+compiled behaviour, not a special case.  Backward comes from autodiff (the
+reference's bwdFilter/bwdData algorithm selection is XLA's job).
+
+Parallelism: the reference allows 4-D (n,h,w) partitions but asserts
+``num_par_c == 1`` (conv_2d.cu:201).  We declare n/h/w splittable —
+GSPMD implements the h/w (attribute) splits with automatic halo exchange,
+replacing the reference's reliance on Legion moving overlapping partition
+rects.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..initializers import GlorotUniform, ZeroInitializer
+from ..op import Op, OpContext, OpType
+from .common import apply_activation, cast_compute
+
+
+class Conv2D(Op):
+    op_type = OpType.CONV2D
+
+    def __init__(self, name, input_tensor, out_channels, kernel_h, kernel_w,
+                 stride_h, stride_w, padding_h, padding_w, activation=None,
+                 use_bias=True, groups=1, kernel_initializer=None,
+                 bias_initializer=None):
+        super().__init__(name, [input_tensor])
+        n, c, h, w = input_tensor.shape
+        self.in_channels, self.out_channels = c, out_channels
+        self.kernel = (kernel_h, kernel_w)
+        self.stride = (stride_h, stride_w)
+        self.padding = (padding_h, padding_w)
+        self.activation = activation
+        self.use_bias = use_bias
+        self.groups = groups
+        out_h = (h + 2 * padding_h - kernel_h) // stride_h + 1
+        out_w = (w + 2 * padding_w - kernel_w) // stride_w + 1
+        self._add_output((n, out_channels, out_h, out_w), input_tensor.dtype)
+        # weight layout OIHW, matching reference create_conv_weight
+        # (model.cc:671-760)
+        self.w_kernel = self._add_weight(
+            (out_channels, c // groups, kernel_h, kernel_w),
+            kernel_initializer or GlorotUniform(), "kernel")
+        if use_bias:
+            self.w_bias = self._add_weight(
+                (out_channels,), bias_initializer or ZeroInitializer(), "bias")
+
+    def forward(self, params, inputs, ctx: OpContext):
+        x = cast_compute(inputs[0], ctx)
+        k = cast_compute(params[self.w_kernel.name], ctx)
+        ph, pw = self.padding
+        # no explicit preferred_element_type: the MXU accumulates bf16 convs
+        # in f32 natively, and JAX's conv transpose rule rejects mixed
+        # operand/accumulator dtypes in the backward pass
+        y = lax.conv_general_dilated(
+            x, k, window_strides=self.stride,
+            padding=[(ph, ph), (pw, pw)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=self.groups)
+        if self.use_bias:
+            y = y + params[self.w_bias.name].astype(y.dtype).reshape(1, -1, 1, 1)
+        y = apply_activation(y, self.activation)
+        return [cast_compute(y, ctx)]
+
+    def parallel_dims(self):
+        # n/h/w splittable, c not (reference conv_2d.cu:201)
+        return (True, False, True, True)
+
+    def flops(self):
+        n, c_out, oh, ow = self.outputs[0].shape
+        kh, kw = self.kernel
+        return 2 * n * c_out * oh * ow * (self.in_channels // self.groups) * kh * kw
+
+
+class Pool2D(Op):
+    """Max/avg pooling (reference pool_2d.cu, cuDNN pooling)."""
+
+    op_type = OpType.POOL2D
+
+    def __init__(self, name, input_tensor, kernel_h, kernel_w, stride_h,
+                 stride_w, padding_h, padding_w, pool_type="max",
+                 activation=None):
+        super().__init__(name, [input_tensor])
+        n, c, h, w = input_tensor.shape
+        self.kernel = (kernel_h, kernel_w)
+        self.stride = (stride_h, stride_w)
+        self.padding = (padding_h, padding_w)
+        self.pool_type = pool_type
+        self.activation = activation
+        out_h = (h + 2 * padding_h - kernel_h) // stride_h + 1
+        out_w = (w + 2 * padding_w - kernel_w) // stride_w + 1
+        self._add_output((n, c, out_h, out_w), input_tensor.dtype)
+
+    def forward(self, params, inputs, ctx: OpContext):
+        x = cast_compute(inputs[0], ctx)
+        ph, pw = self.padding
+        window = (1, 1) + self.kernel
+        strides = (1, 1) + self.stride
+        padding = ((0, 0), (0, 0), (ph, ph), (pw, pw))
+        if self.pool_type == "max":
+            init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+            y = lax.reduce_window(x, init, lax.max, window, strides, padding)
+        else:
+            s = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+            y = s / (self.kernel[0] * self.kernel[1])
+        y = apply_activation(y, self.activation)
+        return [y]
+
+    def parallel_dims(self):
+        return (True, False, True, True)
+
+    def flops(self):
+        return self.outputs[0].volume * self.kernel[0] * self.kernel[1]

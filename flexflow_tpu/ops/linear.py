@@ -1,0 +1,99 @@
+"""Linear / Embedding (reference ``src/ops/linear.cu``, ``src/ops/embedding.cu``).
+
+Linear is the reference's tensor-parallel op: with ``num_par_c > 1`` it
+replicates the input (linear.cu:168-207), computes partial input-grads into a
+3-D replica tensor, and reduces them with a dedicated ``backward2_task``
+saxpy pass (linear.cu:592-619).  TPU-native: the weight is sharded on the
+output-channel dim over the "model" mesh axis; XLA's autodiff + GSPMD emit the
+equivalent ``psum`` over ICI automatically — backward2 is gone by
+construction.
+
+Embedding shards its table over the out-dim (embedding.cu:95-103); the bwd
+``atomicAdd`` scatter (embedding.cu:171-222) becomes the autodiff transpose
+of ``take`` (a segment-sum XLA handles natively).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..initializers import GlorotUniform, ZeroInitializer
+from ..op import Op, OpContext, OpType
+from .common import apply_activation, cast_compute
+
+
+class Linear(Op):
+    op_type = OpType.LINEAR
+
+    def __init__(self, name, input_tensor, out_dim, activation=None,
+                 use_bias=True, kernel_initializer=None, bias_initializer=None):
+        super().__init__(name, [input_tensor])
+        in_dim = input_tensor.shape[-1]
+        self.in_dim, self.out_dim = in_dim, out_dim
+        self.activation = activation
+        self.use_bias = use_bias
+        out_shape = input_tensor.shape[:-1] + (out_dim,)
+        self._add_output(out_shape, input_tensor.dtype)
+        # (out, in) layout, matching reference create_linear_weight
+        # (model.cc:582-669); sharded_dim=0 -> out-channel TP axis
+        self.w_kernel = self._add_weight(
+            (out_dim, in_dim), kernel_initializer or GlorotUniform(),
+            "kernel", sharded_dim=0)
+        if use_bias:
+            self.w_bias = self._add_weight(
+                (out_dim,), bias_initializer or ZeroInitializer(), "bias",
+                sharded_dim=0)
+
+    def forward(self, params, inputs, ctx: OpContext):
+        x = cast_compute(inputs[0], ctx)
+        k = cast_compute(params[self.w_kernel.name], ctx)
+        y = jnp.einsum("...i,oi->...o", x, k,
+                       preferred_element_type=jnp.float32)
+        if self.use_bias:
+            y = y + params[self.w_bias.name].astype(y.dtype)
+        y = apply_activation(y, self.activation)
+        return [cast_compute(y, ctx)]
+
+    def parallel_dims(self):
+        # sample dim + out-channel dim (reference TP axis, §2.15)
+        nd = self.outputs[0].num_dims
+        return (True,) * nd
+
+    def flops(self):
+        batch = self.outputs[0].volume // self.out_dim
+        return 2 * batch * self.in_dim * self.out_dim
+
+
+class Embedding(Op):
+    op_type = OpType.EMBEDDING
+
+    def __init__(self, name, input_tensor, num_entries, out_dim,
+                 aggr="sum", kernel_initializer=None):
+        super().__init__(name, [input_tensor])
+        self.num_entries, self.out_dim, self.aggr = num_entries, out_dim, aggr
+        n = input_tensor.shape[0]
+        self._add_output((n, out_dim), "float32")
+        self.w_table = self._add_weight(
+            (num_entries, out_dim), kernel_initializer or GlorotUniform(),
+            "table", sharded_dim=1)
+
+    def forward(self, params, inputs, ctx: OpContext):
+        idx = inputs[0].astype(jnp.int32)
+        table = params[self.w_table.name]
+        y = jnp.take(table, idx, axis=0)  # (n, [s,] d)
+        if y.ndim == 3:  # bag of indices per sample
+            if self.aggr == "sum":
+                y = y.sum(axis=1)
+            elif self.aggr == "avg":
+                y = y.mean(axis=1)
+            else:
+                raise ValueError(f"unknown aggr {self.aggr!r}")
+        return [cast_compute(y, ctx)]
+
+    def parallel_dims(self):
+        # sample dim only (reference embedding.cu:116)
+        return (True, False)
+
+    def flops(self):
+        return self.outputs[0].volume

@@ -1,0 +1,2 @@
+from .mesh import AXES, MachineMesh, dim_axis_names
+from .sharding import batch_spec, output_spec, param_spec

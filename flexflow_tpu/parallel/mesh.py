@@ -1,0 +1,96 @@
+"""MachineMesh — the TPU device-mesh placement layer.
+
+Replaces the reference's FFMapper (``src/mapper/mapper.cc``,
+``include/mapper.h:26-62``): where the mapper binds each Legion task slice to
+a GPU processor via per-op ``ParallelConfig`` lookups (mapper.cc:33-146), we
+bind logical partition axes to named mesh axes over the ICI fabric and let
+GSPMD place shards.  The five canonical axes mirror the SOAP dimensions:
+
+====  ==========================================================
+axis  meaning
+====  ==========================================================
+n     sample / batch (data parallelism)
+c     channel (tensor/model parallelism — Linear out-dim, §2.15)
+h,w   spatial attribute parallelism (conv h/w splits)
+s     sequence (sequence/context parallelism — new axis; the
+      reference's only sequence partitioning is NMT timestep
+      chunking, nmt/rnn.h:23)
+====  ==========================================================
+
+Axes of size 1 cost nothing; a plain data-parallel run is mesh ``{"n": N}``.
+The reference's ``% devices.size()`` wrap-around (mapper.cc:86-103) — running
+an 8-part strategy on fewer GPUs — maps to testing big meshes on 8 virtual
+CPU devices via ``--xla_force_host_platform_device_count``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+AXES: Tuple[str, ...] = ("n", "c", "h", "w", "s")
+
+# readable aliases accepted in mesh_shape configs
+_ALIAS = {"data": "n", "batch": "n", "model": "c", "tensor": "c",
+          "seq": "s", "sequence": "s", "expert": "c", "pipeline": "h"}
+
+
+class MachineMesh:
+    """A named jax Mesh over the visible devices (or an explicit list)."""
+
+    def __init__(self, shape: Optional[Dict[str, int]] = None,
+                 devices: Optional[Sequence[jax.Device]] = None):
+        devices = list(devices if devices is not None else jax.devices())
+        sizes = {a: 1 for a in AXES}
+        if shape:
+            for k, v in shape.items():
+                sizes[_ALIAS.get(k, k)] = int(v)
+        used = int(np.prod(list(sizes.values())))
+        if used == 1 and len(devices) > 1 and not shape:
+            sizes["n"] = len(devices)  # default: pure data parallel
+            used = len(devices)
+        if used > len(devices):
+            raise ValueError(f"mesh {sizes} needs {used} devices, "
+                             f"have {len(devices)}")
+        devices = devices[:used]
+        dev_array = np.array(devices).reshape([sizes[a] for a in AXES])
+        self.sizes = sizes
+        self.mesh = Mesh(dev_array, AXES)
+        self.num_devices = used
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.num_devices > 1
+
+    def axis_size(self, axis: str) -> int:
+        return self.sizes[_ALIAS.get(axis, axis)]
+
+    def sharding(self, spec: PartitionSpec) -> NamedSharding:
+        return NamedSharding(self.mesh, spec)
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def __repr__(self) -> str:
+        live = {a: s for a, s in self.sizes.items() if s > 1}
+        return f"MachineMesh({live or {'n': 1}}, devices={self.num_devices})"
+
+
+def dim_axis_names(rank: int) -> Tuple[Optional[str], ...]:
+    """Canonical logical-dim -> mesh-axis assignment by tensor rank.
+
+    rank 4 = conv activations (n,c,h,w); rank 3 = sequence activations
+    (n,s,c); rank 2 = (n,c); rank 1 = (c,).
+    """
+    if rank == 4:
+        return ("n", "c", "h", "w")
+    if rank == 3:
+        return ("n", "s", "c")
+    if rank == 2:
+        return ("n", "c")
+    if rank == 1:
+        return ("c",)
+    return tuple([None] * rank)

@@ -1,0 +1,97 @@
+"""ParallelConfig -> jax.sharding translation.
+
+This is the TPU replacement for the reference's partition builders
+(``create_tensor<NDIM>`` model.cc:437-506, ``create_linear_weight``
+model.cc:582-669, ``create_linear_replica`` model.cc:762-817): instead of
+materializing Legion partition trees, each op's resolved ParallelConfig
+becomes a ``PartitionSpec`` constraint on its output, and each Parameter gets
+a NamedSharding.  GSPMD then inserts the collectives the reference got from
+Legion region movement (producer/consumer partition mismatch -> resharding;
+TP partial-grad replicas -> psum; DP grad replicas -> psum in backward).
+
+Mesh-expressibility contract (SURVEY §7 "hard parts"): a config degree for
+logical dim i must equal 1 or the mesh axis size for that dim's canonical
+axis.  The strategy search is constrained to this space.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from jax.sharding import PartitionSpec
+
+from ..config import ParallelConfig
+from ..tensor import Parameter, Tensor
+from .mesh import MachineMesh, dim_axis_names
+
+
+def output_spec(tensor: Tensor, pc: Optional[ParallelConfig],
+                mesh: MachineMesh) -> PartitionSpec:
+    """PartitionSpec for an op output under its ParallelConfig."""
+    rank = tensor.num_dims
+    axes = dim_axis_names(rank)
+    if pc is None:
+        # replicate-by-default except sample dim over 'n'
+        entries = ["n" if (rank > 1 and i == 0 and mesh.axis_size("n") > 1
+                           and tensor.shape[0] % mesh.axis_size("n") == 0)
+                   else None for i in range(rank)]
+        return PartitionSpec(*entries)
+    dims = pc.dims
+    if len(dims) != rank:
+        dims = tuple(dims[:rank]) + (1,) * max(0, rank - len(dims))
+    entries = []
+    for i, (deg, ax) in enumerate(zip(dims, axes)):
+        if deg <= 1 or ax is None:
+            entries.append(None)
+            continue
+        asize = mesh.axis_size(ax)
+        if deg != asize:
+            raise ValueError(
+                f"{tensor.name}: degree {deg} on dim {i} not expressible on "
+                f"mesh axis {ax!r} (size {asize})")
+        if tensor.shape[i] % deg != 0:
+            entries.append(None)
+            continue
+        entries.append(ax)
+    return PartitionSpec(*entries)
+
+
+def param_spec(param: Parameter, pc: Optional[ParallelConfig],
+               mesh: MachineMesh) -> PartitionSpec:
+    """Weight sharding.  DP weights are replicated (the reference keeps one
+    logical weight region with per-replica grads); a channel-parallel op
+    shards its weight on ``sharded_dim`` over axis 'c'
+    (reference create_linear_weight, model.cc:582-669)."""
+    if (pc is None or param.sharded_dim is None
+            or mesh.axis_size("c") <= 1):
+        return PartitionSpec()
+    # channel degree sits at the canonical 'c' position of the *output*
+    rank = len(pc.dims)
+    axes = dim_axis_names(rank)
+    c_deg = 1
+    for deg, ax in zip(pc.dims, axes):
+        if ax == "c":
+            c_deg = deg
+    if c_deg <= 1:
+        return PartitionSpec()
+    if c_deg != mesh.axis_size("c"):
+        raise ValueError(f"{param.name}: channel degree {c_deg} != mesh c "
+                         f"axis {mesh.axis_size('c')}")
+    if param.shape[param.sharded_dim] % c_deg != 0:
+        return PartitionSpec()
+    entries = [None] * len(param.shape)
+    entries[param.sharded_dim] = "c"
+    return PartitionSpec(*entries)
+
+
+def batch_spec(rank: int, mesh: MachineMesh,
+               seq_sharded: bool = False) -> PartitionSpec:
+    """Input-batch sharding: sample dim over 'n' (the reference dataloader's
+    batch partition, flexflow_dataloader.cc:260-330), optional sequence dim
+    over 's' for context parallelism."""
+    entries: list = [None] * rank
+    if rank >= 1 and mesh.axis_size("n") > 1:
+        entries[0] = "n"
+    if seq_sharded and rank >= 2 and mesh.axis_size("s") > 1:
+        entries[1] = "s"
+    return PartitionSpec(*entries)

@@ -1,0 +1,1 @@
+from .mcmc import optimize_strategies

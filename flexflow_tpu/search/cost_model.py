@@ -1,0 +1,87 @@
+"""Analytic TPU cost model for the strategy simulator.
+
+Replaces the reference's device model (``src/runtime/simulator.cu:27-29``:
+inter-GPU 20 GB/s, inter-node 12/numNodes GB/s, GPU<->DRAM 16 GB/s) and its
+on-hardware cuDNN microbenchmarks (conv_2d.cu:935-1037) with an MXU
+roofline + ICI/DCN bandwidth table.  Default constants are TPU v5p per-chip
+figures (scaling-book numbers); override via ``DeviceSpec`` for other
+generations, or use measure mode (simulator.py) for on-hardware calibration
+— the same two-tier design as the reference (analytic scripts/simulator.cc
+vs measured simulator.cc:235-273).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+from ..op import Op, OpType
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceSpec:
+    """Per-chip TPU capability model."""
+
+    mxu_flops: float = 459e12        # bf16 FLOP/s (v5p)
+    vpu_flops: float = 7e12          # elementwise FLOP/s
+    hbm_bw: float = 2765e9           # bytes/s
+    ici_bw: float = 90e9             # bytes/s per link direction
+    dcn_bw: float = 25e9             # bytes/s per host (multi-slice)
+    ici_latency: float = 1e-6        # s
+    kernel_launch: float = 2e-6      # per-fused-region overhead (XLA amortizes)
+
+
+DEFAULT_SPEC = DeviceSpec()
+
+# ops whose arithmetic runs on the VPU, not the MXU
+_VPU_OPS = {
+    OpType.ELEMENT_UNARY, OpType.ELEMENT_BINARY, OpType.SOFTMAX,
+    OpType.BATCHNORM, OpType.LAYERNORM, OpType.RMSNORM, OpType.DROPOUT,
+    OpType.POOL2D, OpType.EMBEDDING, OpType.CONCAT, OpType.SPLIT,
+    OpType.FLAT, OpType.RESHAPE, OpType.TRANSPOSE,
+}
+
+
+def op_compute_time(op: Op, part_degrees: Tuple[int, ...],
+                    spec: DeviceSpec = DEFAULT_SPEC,
+                    dtype_bytes: int = 2, backward: bool = False) -> float:
+    """Roofline time for ONE partition of ``op`` under the given degrees:
+    max(compute, memory) + launch overhead.  Backward ~= 2x forward FLOPs
+    (dgrad + wgrad), matching the reference's separate bwdData/bwdFilter
+    measurement."""
+    nparts = 1
+    for d in part_degrees:
+        nparts *= d
+    flops = op.flops() / max(1, nparts)
+    if backward:
+        flops *= 2.0
+    peak = spec.vpu_flops if op.op_type in _VPU_OPS else spec.mxu_flops
+    io_bytes = 0
+    for t in list(op.inputs) + list(op.outputs):
+        io_bytes += t.volume * dtype_bytes
+    io_bytes += sum(w.volume * 4 for w in op.weights)
+    io_bytes /= max(1, nparts)
+    if backward:
+        io_bytes *= 2.0
+    return max(flops / peak, io_bytes / spec.hbm_bw) + spec.kernel_launch
+
+
+def transfer_time(nbytes: float, intra_slice: bool,
+                  spec: DeviceSpec = DEFAULT_SPEC) -> float:
+    """Point-to-point transfer cost (reference simulator.cc:200-233: 1 comm
+    task intra-node, 3-hop chain inter-node; here: ICI hop vs DCN hop)."""
+    if nbytes <= 0:
+        return 0.0
+    bw = spec.ici_bw if intra_slice else spec.dcn_bw
+    return spec.ici_latency + nbytes / bw
+
+
+def allreduce_time(nbytes: float, num_replicas: int,
+                   spec: DeviceSpec = DEFAULT_SPEC) -> float:
+    """Ring-allreduce cost over ICI: 2*(k-1)/k * bytes / bw.  This replaces
+    the reference's single-GPU replica-sum gather (optimizer_kernel.cu:168-179,
+    costed as 2*weight_volume per extra replica in simulator.cc:358-408)."""
+    if num_replicas <= 1 or nbytes <= 0:
+        return 0.0
+    k = num_replicas
+    return spec.ici_latency * (k - 1) + 2.0 * (k - 1) / k * nbytes / spec.ici_bw

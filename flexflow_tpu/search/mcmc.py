@@ -1,0 +1,150 @@
+"""MCMC / simulated-annealing strategy search (reference
+``FFModel::optimize`` model.cc:1020-1054, ``rewrite`` model.cc:1012-1018).
+
+Identical loop shape: start from data parallelism, propose a single-op
+mutation to a random legal config, accept if the simulated runtime improves,
+else accept with probability ``exp(-alpha * delta)``; budget/alpha from the
+``--budget`` / ``--alpha`` flags (model.cc:1253-1260).
+
+Mesh-expressibility: candidate configs are drawn from axis-aligned
+factorizations of the device count over the canonical mesh axes
+(n/c/h/w/s), the constraint under which GSPMD can realize any joint
+assignment (SURVEY §7 "hard parts").  A C++ implementation of the hot
+simulate+propose loop lives in flexflow_tpu/native (used when built); this
+module is the always-available reference implementation and the entry point.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Dict, List, Optional, Tuple
+
+from ..config import FFConfig, ParallelConfig
+from ..op import Op
+from .cost_model import DEFAULT_SPEC, DeviceSpec
+from .simulator import Simulator
+
+
+def _factorizations(n: int, slots: int) -> List[Tuple[int, ...]]:
+    """All ordered factorizations of n into `slots` positive factors."""
+    if slots == 1:
+        return [(n,)]
+    out = []
+    d = 1
+    while d <= n:
+        if n % d == 0:
+            for rest in _factorizations(n // d, slots - 1):
+                out.append((d,) + rest)
+        d += 1
+    return out
+
+
+def legal_configs(op: Op, num_devices: int,
+                  max_candidates: int = 64) -> List[ParallelConfig]:
+    """Legal mesh-expressible configs for one op (reference
+    Op::get_random_parallel_config, model.cc:276-305, which samples
+    factorizations of the device count over the op's partitionable dims)."""
+    out_t = op.outputs[0]
+    nd = out_t.num_dims
+    allowed = op.parallel_dims()
+    cands: List[ParallelConfig] = []
+    for total in {d for d in range(1, num_devices + 1) if num_devices % d == 0}:
+        for dims in _factorizations(total, nd):
+            ok = True
+            for i, deg in enumerate(dims):
+                if deg > 1 and (i >= len(allowed) or not allowed[i]):
+                    ok = False
+                    break
+                if deg > 1 and out_t.shape[i] % deg != 0:
+                    ok = False
+                    break
+            if ok:
+                cands.append(ParallelConfig(
+                    dims=dims, device_ids=tuple(range(_prod(dims)))))
+    # dedupe, cap
+    seen = set()
+    uniq = []
+    for c in cands:
+        if c.dims not in seen:
+            seen.add(c.dims)
+            uniq.append(c)
+    return uniq[:max_candidates]
+
+
+def _prod(xs) -> int:
+    n = 1
+    for x in xs:
+        n *= x
+    return n
+
+
+def search(layers: List[Op], num_devices: int, budget: int = 1000,
+           alpha: float = 0.05, seed: int = 0,
+           spec: DeviceSpec = DEFAULT_SPEC, measure: bool = False,
+           overlap_backward_update: bool = False,
+           verbose: bool = False) -> Tuple[Dict[str, ParallelConfig], float]:
+    """Run the annealing loop; returns (best strategies, best sim time)."""
+    # try the native C++ hot loop first
+    try:
+        from ..native import ffi as native_ffi
+        if native_ffi.available():
+            return native_ffi.mcmc_search(
+                layers, num_devices, budget, alpha, seed, spec,
+                overlap_backward_update, verbose)
+    except ImportError:
+        pass
+    return _py_search(layers, num_devices, budget, alpha, seed, spec,
+                      measure, overlap_backward_update, verbose)
+
+
+def _py_search(layers, num_devices, budget, alpha, seed, spec, measure,
+               overlap_backward_update, verbose):
+    rng = random.Random(seed)
+    sim = Simulator(spec=spec, num_devices=num_devices, measure=measure)
+    cand_cache = {op.name: legal_configs(op, num_devices) for op in layers}
+    searchable = [op for op in layers if cand_cache[op.name]]
+
+    # start from data parallelism (model.cc:1020-1027)
+    current: Dict[str, ParallelConfig] = {}
+    for op in layers:
+        nd = op.outputs[0].num_dims
+        deg = num_devices
+        while deg > 1 and op.outputs[0].shape[0] % deg != 0:
+            deg //= 2
+        current[op.name] = ParallelConfig.data_parallel(deg, nd)
+    cur_time = sim.simulate(layers, current, overlap_backward_update)
+    best, best_time = dict(current), cur_time
+    for it in range(budget):
+        op = rng.choice(searchable)
+        new_cfg = rng.choice(cand_cache[op.name])
+        if new_cfg.dims == current[op.name].dims:
+            continue
+        proposal = dict(current)
+        proposal[op.name] = new_cfg
+        new_time = sim.simulate(layers, proposal, overlap_backward_update)
+        delta = new_time - cur_time
+        if delta < 0 or (math.isfinite(new_time) and
+                         rng.random() < math.exp(-alpha * delta * 1e3)):
+            current, cur_time = proposal, new_time
+            if cur_time < best_time:
+                best, best_time = dict(current), cur_time
+                if verbose:
+                    print(f"[search] iter {it}: {best_time * 1e3:.3f} ms")
+    return best, best_time
+
+
+def optimize_strategies(model, cfg: FFConfig) -> Dict[str, ParallelConfig]:
+    """Entry point used by FFModel.compile when ``--budget > 0``
+    (reference model.cc:953-966 launching STRATEGY_SEARCH_TASK)."""
+    import jax
+
+    ndev = cfg.num_devices if cfg.workers_per_node else len(jax.devices())
+    best, best_time = search(
+        model.layers, ndev, budget=cfg.search_budget,
+        alpha=cfg.search_alpha, seed=cfg.seed,
+        measure=(cfg.simulator_mode == "measure"),
+        overlap_backward_update=cfg.search_overlap_backward_update)
+    print(f"[search] best simulated iteration time: {best_time * 1e3:.3f} ms "
+          f"on {ndev} devices")
+    return best

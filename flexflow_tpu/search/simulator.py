@@ -1,0 +1,269 @@
+"""Execution simulator for strategy search.
+
+Same architecture as the reference (``src/runtime/simulator.{h,cc}``): build
+a task graph of FORWARD/BACKWARD/COMM/UPDATE SimTasks from the model + a
+candidate strategy, add dependency edges where producer/consumer partitions
+intersect, then run an event-driven simulation with per-device ready queues
+(simulate_runtime, simulator.cc:275-448).  Differences, by design:
+
+* per-op times come from the analytic TPU roofline (cost_model.py) by
+  default; ``measure=True`` compiles and times each op sub-shape on the real
+  chip, cached by (op, config) hash like the reference's measure path
+  (simulator.cc:235-273);
+* weight sync is costed as a ring allreduce over ICI rather than the
+  reference's gather-to-one-GPU model, with the same
+  ``overlap_backward_update`` option (simulator.cc:327-408).
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..config import FFConfig, ParallelConfig
+from ..op import Op
+from ..tensor import Tensor
+from .cost_model import (DEFAULT_SPEC, DeviceSpec, allreduce_time,
+                         op_compute_time, transfer_time)
+
+
+class SimTask:
+    __slots__ = ("ready_time", "run_time", "device", "next_tasks",
+                 "remaining_deps", "kind")
+
+    def __init__(self, run_time: float, device: int, kind: str):
+        self.ready_time = 0.0
+        self.run_time = run_time
+        self.device = device
+        self.kind = kind
+        self.next_tasks: List["SimTask"] = []
+        self.remaining_deps = 0
+
+    def add_next(self, t: "SimTask") -> None:
+        self.next_tasks.append(t)
+        t.remaining_deps += 1
+
+
+def _part_coords(dims: Tuple[int, ...]):
+    """Row-major enumeration of partition coordinates."""
+    idx = np.indices(dims).reshape(len(dims), -1).T
+    return [tuple(c) for c in idx]
+
+
+def _part_rect(shape, dims, coord):
+    """[lo, hi) box of one partition."""
+    lo, hi = [], []
+    for s, d, c in zip(shape, dims, coord):
+        step = s // d
+        lo.append(c * step)
+        hi.append((c + 1) * step if c < d - 1 else s)
+    return tuple(lo), tuple(hi)
+
+
+def _overlap_volume(lo1, hi1, lo2, hi2) -> int:
+    v = 1
+    for a1, b1, a2, b2 in zip(lo1, hi1, lo2, hi2):
+        o = min(b1, b2) - max(a1, a2)
+        if o <= 0:
+            return 0
+        v *= o
+    return v
+
+
+class Simulator:
+    def __init__(self, spec: DeviceSpec = DEFAULT_SPEC,
+                 num_devices: int = 1, devices_per_slice: int = 0,
+                 measure: bool = False, dtype_bytes: int = 2):
+        self.spec = spec
+        self.num_devices = num_devices
+        self.devices_per_slice = devices_per_slice or num_devices
+        self.measure = measure
+        self.dtype_bytes = dtype_bytes
+        self._measure_cache: Dict[Tuple, float] = {}
+
+    # --------------------------------------------------------------
+    def _op_time(self, op: Op, dims: Tuple[int, ...], backward: bool) -> float:
+        if self.measure:
+            key = (op.name, dims, backward)
+            if key not in self._measure_cache:
+                self._measure_cache[key] = self._measure_op(op, dims, backward)
+            return self._measure_cache[key]
+        return op_compute_time(op, dims, self.spec, self.dtype_bytes, backward)
+
+    def _measure_op(self, op: Op, dims: Tuple[int, ...], backward: bool) -> float:
+        """On-hardware microbenchmark of one op sub-shape (reference
+        Op::measure_compute_time).  Compiles the op's forward (or fwd+vjp)
+        at the per-part shape and times it on the default device."""
+        import time
+
+        import jax
+        import jax.numpy as jnp
+
+        from ..op import OpContext
+
+        try:
+            sub_shapes = [t.sub_shape(tuple(dims[:t.num_dims]) +
+                                      (1,) * max(0, t.num_dims - len(dims)))
+                          for t in op.inputs]
+        except AssertionError:
+            return float("inf")  # indivisible -> invalid config
+        ctx = OpContext(training=True, rng=jax.random.PRNGKey(0))
+        params = {}
+        for w in op.weights:
+            params[w.name] = jnp.zeros(w.shape, jnp.float32)
+        args = [jnp.zeros(s, jnp.bfloat16 if t.dtype == "float32" else t.dtype)
+                for s, t in zip(sub_shapes, op.inputs)]
+
+        def f(params, args):
+            out = op.forward(params, list(args), ctx)
+            return sum(jnp.sum(o.astype(jnp.float32)) for o in out)
+
+        fn = jax.jit(jax.grad(f) if backward else f)
+        try:
+            r = fn(params, args)
+            jax.block_until_ready(r)
+            t0 = time.perf_counter()
+            for _ in range(3):
+                r = fn(params, args)
+            jax.block_until_ready(r)
+            return (time.perf_counter() - t0) / 3
+        except Exception:
+            return float("inf")
+
+    # --------------------------------------------------------------
+    def simulate(self, layers: List[Op],
+                 strategies: Dict[str, ParallelConfig],
+                 overlap_backward_update: bool = False) -> float:
+        """Simulated per-iteration runtime (seconds) — the MCMC objective
+        (reference simulate_runtime, simulator.cc:275-448)."""
+        tasks: List[SimTask] = []
+        # per-(tensor uid) -> list of (coord-rect, fwd task, device)
+        produced: Dict[int, List[Tuple]] = {}
+        fwd_of: Dict[str, List[SimTask]] = {}
+        bwd_of: Dict[str, List[SimTask]] = {}
+
+        def cfg_for(op: Op) -> ParallelConfig:
+            pc = strategies.get(op.name)
+            if pc is None:
+                nd = op.outputs[0].num_dims
+                pc = ParallelConfig.data_parallel(
+                    min(self.num_devices, op.outputs[0].shape[0]), nd)
+            return pc
+
+        # 1) forward + backward tasks per partition
+        for op in layers:
+            pc = cfg_for(op)
+            dims = pc.dims
+            out = op.outputs[0]
+            if len(dims) != out.num_dims:
+                dims = tuple(dims[: out.num_dims]) + \
+                    (1,) * max(0, out.num_dims - len(dims))
+            ft = self._op_time(op, dims, backward=False)
+            bt = self._op_time(op, dims, backward=True)
+            if not np.isfinite(ft) or not np.isfinite(bt):
+                return float("inf")
+            coords = _part_coords(dims)
+            f_tasks, b_tasks = [], []
+            for i, coord in enumerate(coords):
+                dev = pc.device_ids[i % len(pc.device_ids)] % self.num_devices
+                tf_ = SimTask(ft, dev, "fwd")
+                tb_ = SimTask(bt, dev, "bwd")
+                tasks += [tf_, tb_]
+                f_tasks.append(tf_)
+                b_tasks.append(tb_)
+                lo, hi = _part_rect(out.shape, dims, coord)
+                produced.setdefault(out.uid, []).append((lo, hi, tf_, tb_, dev))
+            fwd_of[op.name] = f_tasks
+            bwd_of[op.name] = b_tasks
+
+            # 2) dependency + comm edges from producers
+            for t_in in op.inputs:
+                if t_in.uid not in produced:
+                    continue
+                prods = produced[t_in.uid]
+                for i, coord in enumerate(coords):
+                    dev = pc.device_ids[i % len(pc.device_ids)] % self.num_devices
+                    # consumer reads its input rect = project output coord
+                    in_dims = tuple(dims[: t_in.num_dims]) + \
+                        (1,) * max(0, t_in.num_dims - len(dims))
+                    in_dims = tuple(min(d, s) if s % max(1, d) == 0 else 1
+                                    for d, s in zip(in_dims, t_in.shape))
+                    ccoord = tuple(c % d for c, d in zip(coord, in_dims))
+                    lo_c, hi_c = _part_rect(t_in.shape, in_dims, ccoord)
+                    for (lo_p, hi_p, tf_p, tb_p, dev_p) in prods:
+                        vol = _overlap_volume(lo_p, hi_p, lo_c, hi_c)
+                        if vol == 0:
+                            continue
+                        ctask_f = f_tasks[i]
+                        ctask_b = b_tasks[i]
+                        if dev_p != dev:
+                            nb = vol * self.dtype_bytes
+                            intra = (dev_p // self.devices_per_slice ==
+                                     dev // self.devices_per_slice)
+                            ct = SimTask(transfer_time(nb, intra, self.spec),
+                                         dev_p, "comm")
+                            tasks.append(ct)
+                            tf_p.add_next(ct)
+                            ct.add_next(ctask_f)
+                            # mirrored comm for the gradient in backward
+                            ct2 = SimTask(transfer_time(nb, intra, self.spec),
+                                          dev, "comm")
+                            tasks.append(ct2)
+                            ctask_b.add_next(ct2)
+                            ct2.add_next(tb_p)
+                        else:
+                            tf_p.add_next(ctask_f)
+                            ctask_b.add_next(tb_p)
+
+        # 3) backward ordering: bwd of an op waits for its own fwd
+        for op in layers:
+            for tf_, tb_ in zip(fwd_of[op.name], bwd_of[op.name]):
+                tf_.add_next(tb_)
+
+        # 4) weight sync (update) tasks: ring allreduce per parameter over
+        # its replica set (reference simulator.cc:327-408)
+        update_total = 0.0
+        for op in layers:
+            pc = cfg_for(op)
+            if not op.weights:
+                continue
+            replicas = pc.num_parts  # DP replicas share the weight
+            wbytes = sum(w.volume * 4 for w in op.weights if w.trainable)
+            t_sync = allreduce_time(wbytes, min(replicas, self.num_devices),
+                                    self.spec)
+            if overlap_backward_update:
+                ut = SimTask(t_sync, 0, "update")
+                tasks.append(ut)
+                for tb_ in bwd_of[op.name]:
+                    tb_.add_next(ut)
+            else:
+                update_total += t_sync
+
+        # 5) event-driven simulation (priority queue over ready tasks)
+        dev_free = [0.0] * self.num_devices
+        heap: List[Tuple[float, int, SimTask]] = []
+        uid = 0
+        for t in tasks:
+            if t.remaining_deps == 0:
+                heapq.heappush(heap, (t.ready_time, uid, t))
+                uid += 1
+        finish = 0.0
+        processed = 0
+        while heap:
+            ready, _, t = heapq.heappop(heap)
+            start = max(ready, dev_free[t.device])
+            end = start + t.run_time
+            dev_free[t.device] = end
+            finish = max(finish, end)
+            processed += 1
+            for nxt in t.next_tasks:
+                nxt.ready_time = max(nxt.ready_time, end)
+                nxt.remaining_deps -= 1
+                if nxt.remaining_deps == 0:
+                    heapq.heappush(heap, (nxt.ready_time, uid, nxt))
+                    uid += 1
+        if processed != len(tasks):
+            return float("inf")  # cycle — invalid graph
+        return finish + update_total

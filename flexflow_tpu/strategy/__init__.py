@@ -1,0 +1,1 @@
+from .proto import (dumps, load_strategy_file, loads, save_strategy_file)

@@ -1,0 +1,169 @@
+"""Strategy-file I/O, wire-compatible with the reference's protobuf format.
+
+Reference: ``src/runtime/strategy.proto:5-23`` (proto2) and the load/save
+logic in ``src/runtime/strategy.cc:87-163``.  Message layout:
+
+    message Op { required string name = 1;
+                 required DeviceType device_type = 2;   // GPU=0, CPU=1
+                 repeated int32 dims = 3;               // innermost-first!
+                 repeated int32 device_ids = 4;
+                 repeated MemoryType memory_types = 5; }
+    message Strategy { repeated Op ops = 1; }
+
+We hand-roll the proto2 wire format (varints + length-delimited fields) so
+existing ``.pb`` strategy files parse without a protobuf runtime dependency.
+The reference stores ``dim[]`` innermost-first (sample dim *last* — see
+``Op::get_data_parallel_config``, model.cc:263-274); flexflow_tpu uses
+natural outermost-first order, so dims are reversed at this boundary.
+Readers accept both packed and unpacked repeated encodings; the writer emits
+unpacked, matching proto2's default for repeated int32.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Dict, List, Tuple
+
+from ..config import DeviceType, MemoryType, ParallelConfig
+
+_WIRE_VARINT = 0
+_WIRE_LEN = 2
+
+
+def _read_varint(buf: memoryview, pos: int) -> Tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return result, pos
+        shift += 7
+
+
+def _write_varint(out: io.BytesIO, value: int) -> None:
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.write(bytes([b | 0x80]))
+        else:
+            out.write(bytes([b]))
+            return
+
+
+def _parse_repeated_int32(buf: memoryview, pos: int, wire: int,
+                          dest: List[int]) -> int:
+    if wire == _WIRE_VARINT:
+        v, pos = _read_varint(buf, pos)
+        dest.append(v)
+    elif wire == _WIRE_LEN:  # packed
+        ln, pos = _read_varint(buf, pos)
+        end = pos + ln
+        while pos < end:
+            v, pos = _read_varint(buf, pos)
+            dest.append(v)
+    else:
+        raise ValueError(f"bad wire type {wire} for repeated int32")
+    return pos
+
+
+def _parse_op(data: bytes) -> Tuple[str, ParallelConfig]:
+    buf = memoryview(data)
+    pos = 0
+    name = ""
+    device_type = 0
+    dims: List[int] = []
+    device_ids: List[int] = []
+    memory_types: List[int] = []
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if field == 1:
+            ln, pos = _read_varint(buf, pos)
+            name = bytes(buf[pos:pos + ln]).decode("utf-8")
+            pos += ln
+        elif field == 2:
+            device_type, pos = _read_varint(buf, pos)
+        elif field == 3:
+            pos = _parse_repeated_int32(buf, pos, wire, dims)
+        elif field == 4:
+            pos = _parse_repeated_int32(buf, pos, wire, device_ids)
+        elif field == 5:
+            pos = _parse_repeated_int32(buf, pos, wire, memory_types)
+        else:  # skip unknown
+            if wire == _WIRE_VARINT:
+                _, pos = _read_varint(buf, pos)
+            elif wire == _WIRE_LEN:
+                ln, pos = _read_varint(buf, pos)
+                pos += ln
+            else:
+                raise ValueError(f"unknown wire type {wire}")
+    pc = ParallelConfig(
+        device_type=DeviceType(device_type),
+        dims=tuple(reversed(dims)),  # file is innermost-first
+        device_ids=tuple(device_ids) or tuple(
+            range(max(1, _prod(dims)))),
+        memory_types=tuple(MemoryType(m) for m in memory_types),
+    )
+    return name, pc
+
+
+def _prod(xs) -> int:
+    n = 1
+    for x in xs:
+        n *= x
+    return n
+
+
+def loads(data: bytes) -> Dict[str, ParallelConfig]:
+    buf = memoryview(data)
+    pos = 0
+    out: Dict[str, ParallelConfig] = {}
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if field == 1 and wire == _WIRE_LEN:
+            ln, pos = _read_varint(buf, pos)
+            name, pc = _parse_op(bytes(buf[pos:pos + ln]))
+            pos += ln
+            out[name] = pc
+        else:
+            raise ValueError(f"unexpected top-level field {field}/{wire}")
+    return out
+
+
+def dumps(strategies: Dict[str, ParallelConfig]) -> bytes:
+    top = io.BytesIO()
+    for name, pc in strategies.items():
+        op = io.BytesIO()
+        nb = name.encode("utf-8")
+        _write_varint(op, (1 << 3) | _WIRE_LEN)
+        _write_varint(op, len(nb))
+        op.write(nb)
+        _write_varint(op, (2 << 3) | _WIRE_VARINT)
+        _write_varint(op, int(pc.device_type))
+        for d in reversed(pc.dims):  # back to innermost-first
+            _write_varint(op, (3 << 3) | _WIRE_VARINT)
+            _write_varint(op, int(d))
+        for d in pc.device_ids:
+            _write_varint(op, (4 << 3) | _WIRE_VARINT)
+            _write_varint(op, int(d))
+        for m in pc.memory_types:
+            _write_varint(op, (5 << 3) | _WIRE_VARINT)
+            _write_varint(op, int(m))
+        body = op.getvalue()
+        _write_varint(top, (1 << 3) | _WIRE_LEN)
+        _write_varint(top, len(body))
+        top.write(body)
+    return top.getvalue()
+
+
+def load_strategy_file(path: str) -> Dict[str, ParallelConfig]:
+    with open(path, "rb") as f:
+        return loads(f.read())
+
+
+def save_strategy_file(path: str, strategies: Dict[str, ParallelConfig]) -> None:
+    with open(path, "wb") as f:
+        f.write(dumps(strategies))

@@ -1,0 +1,97 @@
+"""Symbolic tensor & parameter handles for the FFModel graph.
+
+TPU-native counterpart of the reference's ``Tensor``/``Parameter``
+(``include/model.h:131-181``).  The reference Tensor owns Legion regions and
+partitions; here a Tensor is a *symbolic* handle (shape/dtype/owner) — the
+actual array lives in a jax pytree that XLA shards according to the resolved
+strategy, so there is no map/unmap or raw-pointer attach: ``get_weights`` /
+``set_weights`` (reference ``model.cu:260-370``) operate on the model's
+parameter pytree directly.
+
+Shapes are natural (row-major, sample dim first); the reference stores
+``adim[]`` innermost-first and we convert only at the strategy-file boundary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Optional, Tuple
+
+import numpy as np
+
+_uid = itertools.count()
+
+
+@dataclasses.dataclass
+class Tensor:
+    """A node value in the op graph.
+
+    ``sub_shape`` math (reference ``get_input/output_sub_tensor``
+    model.cc:95-126) lives in :meth:`sub_shape`: the per-part shape under a
+    ParallelConfig, used by the simulator's cost model.
+    """
+
+    shape: Tuple[int, ...]
+    dtype: str = "float32"
+    name: str = ""
+    owner_op: Optional[object] = None  # Op that produces this tensor
+    owner_idx: int = 0
+    uid: int = dataclasses.field(default_factory=lambda: next(_uid))
+
+    @property
+    def num_dims(self) -> int:
+        return len(self.shape)
+
+    @property
+    def volume(self) -> int:
+        v = 1
+        for s in self.shape:
+            v *= s
+        return int(v)
+
+    def sub_shape(self, dims: Tuple[int, ...]) -> Tuple[int, ...]:
+        """Per-part shape when partitioned with degree ``dims[i]`` on dim i."""
+        assert len(dims) == len(self.shape), (dims, self.shape)
+        out = []
+        for s, d in zip(self.shape, dims):
+            assert s % d == 0, f"dim {s} not divisible by degree {d}"
+            out.append(s // d)
+        return tuple(out)
+
+    def __hash__(self) -> int:
+        return self.uid
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Tensor) and other.uid == self.uid
+
+    def __repr__(self) -> str:
+        return f"Tensor(name={self.name!r}, shape={self.shape}, dtype={self.dtype})"
+
+
+@dataclasses.dataclass
+class Parameter(Tensor):
+    """A trainable weight (reference ``Parameter``, model.h:169-181).
+
+    ``pcname`` is the strategy key: the op name whose ParallelConfig governs
+    this weight's sharding (reference keys strategies by op-name hash,
+    strategy.cc:23-26 — we key by the name itself).
+    """
+
+    pcname: str = ""
+    initializer: Optional[object] = None
+    # model-parallel sharding hint resolved at compile()
+    sharded_dim: Optional[int] = None
+    # False for op state (e.g. batchnorm running stats): excluded from the
+    # optimizer, updated functionally via OpContext.updates
+    trainable: bool = True
+
+    def __hash__(self) -> int:
+        return self.uid
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Parameter) and other.uid == self.uid
+
+
+def np_dtype(dtype: str):
+    return np.dtype(dtype)

@@ -1,0 +1,20 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh so distributed
+behavior is exercised without TPU hardware (SURVEY §4: the TPU-side answer to
+the reference's lack of cluster-free distributed testing).
+
+The environment may pre-register an accelerator PJRT plugin that overrides
+JAX_PLATFORMS, so we force the platform through jax.config (effective until
+backend initialization) rather than the env var.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "float32")

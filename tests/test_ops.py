@@ -1,0 +1,181 @@
+"""Single-device op correctness vs numpy references (the unit-test tier the
+reference lacks — SURVEY §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu.op import OpContext
+from flexflow_tpu.ops.conv import Conv2D, Pool2D
+from flexflow_tpu.ops.elementwise import ElementBinary, ElementUnary
+from flexflow_tpu.ops.linear import Embedding, Linear
+from flexflow_tpu.ops.norm import BatchNorm, LayerNorm, RMSNorm
+from flexflow_tpu.ops.tensor_ops import (Concat, Dropout, Flat, Softmax,
+                                         Split)
+from flexflow_tpu.tensor import Tensor
+
+
+def ctx32(**kw):
+    return OpContext(compute_dtype="float32",
+                     rng=jax.random.PRNGKey(0), **kw)
+
+
+def init_params(op, seed=0):
+    key = jax.random.PRNGKey(seed)
+    params = {}
+    for i, w in enumerate(op.weights):
+        init = w.initializer
+        params[w.name] = init(jax.random.fold_in(key, i), w.shape,
+                              jnp.float32)
+    return params
+
+
+def test_linear_matches_numpy():
+    t = Tensor((4, 8), name="x")
+    op = Linear("fc", t, 16, activation=None)
+    params = init_params(op)
+    x = np.random.randn(4, 8).astype(np.float32)
+    y = op.forward(params, [jnp.asarray(x)], ctx32())[0]
+    ref = x @ np.asarray(params[op.w_kernel.name]).T + \
+        np.asarray(params[op.w_bias.name])
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-5, atol=1e-5)
+    assert op.outputs[0].shape == (4, 16)
+
+
+def test_linear_relu():
+    t = Tensor((2, 4))
+    op = Linear("fc", t, 4, activation="relu")
+    params = init_params(op)
+    y = op.forward(params, [jnp.ones((2, 4))], ctx32())[0]
+    assert np.all(np.asarray(y) >= 0)
+
+
+def test_conv2d_shape_and_value():
+    t = Tensor((2, 3, 8, 8), name="img")
+    op = Conv2D("conv", t, 4, 3, 3, 1, 1, 1, 1)
+    assert op.outputs[0].shape == (2, 4, 8, 8)
+    params = init_params(op)
+    x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+    y = np.asarray(op.forward(params, [jnp.asarray(x)], ctx32())[0])
+    # check one output element against a naive dot product
+    k = np.asarray(params[op.w_kernel.name])
+    xpad = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    want = np.sum(xpad[0, :, 3:6, 4:7] * k[1]) + \
+        np.asarray(params[op.w_bias.name])[1]
+    np.testing.assert_allclose(y[0, 1, 3, 4], want, rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_stride_padding_shape():
+    t = Tensor((1, 3, 229, 229))
+    op = Conv2D("conv1", t, 64, 11, 11, 4, 4, 2, 2, activation="relu")
+    # reference AlexNet conv1 output: (229+4-11)/4+1 = 56
+    assert op.outputs[0].shape == (1, 64, 56, 56)
+
+
+def test_pool2d_max_avg():
+    t = Tensor((1, 2, 4, 4))
+    x = jnp.arange(32, dtype=jnp.float32).reshape(1, 2, 4, 4)
+    mp = Pool2D("mp", t, 2, 2, 2, 2, 0, 0, "max")
+    ap = Pool2D("ap", t, 2, 2, 2, 2, 0, 0, "avg")
+    ym = np.asarray(mp.forward({}, [x], ctx32())[0])
+    ya = np.asarray(ap.forward({}, [x], ctx32())[0])
+    assert ym.shape == (1, 2, 2, 2)
+    assert ym[0, 0, 0, 0] == 5.0
+    assert ya[0, 0, 0, 0] == 2.5
+
+
+def test_flat():
+    t = Tensor((2, 3, 4, 5))
+    op = Flat("flat", t)
+    assert op.outputs[0].shape == (2, 60)
+    y = op.forward({}, [jnp.ones((2, 3, 4, 5))], ctx32())[0]
+    assert y.shape == (2, 60)
+
+
+def test_softmax_rows_sum_to_one():
+    t = Tensor((3, 7))
+    op = Softmax("sm", t)
+    y = np.asarray(op.forward({}, [jnp.asarray(
+        np.random.randn(3, 7).astype(np.float32))], ctx32())[0])
+    np.testing.assert_allclose(y.sum(-1), np.ones(3), rtol=1e-5)
+
+
+def test_concat_split_roundtrip():
+    a, b = Tensor((2, 3)), Tensor((2, 5))
+    cat = Concat("cat", [a, b], axis=1)
+    assert cat.outputs[0].shape == (2, 8)
+    xa = jnp.asarray(np.random.randn(2, 3).astype(np.float32))
+    xb = jnp.asarray(np.random.randn(2, 5).astype(np.float32))
+    y = cat.forward({}, [xa, xb], ctx32())[0]
+    sp = Split("sp", cat.outputs[0], [3, 5], axis=1)
+    ya, yb = sp.forward({}, [y], ctx32())
+    np.testing.assert_allclose(np.asarray(ya), np.asarray(xa))
+    np.testing.assert_allclose(np.asarray(yb), np.asarray(xb))
+
+
+def test_element_ops():
+    t = Tensor((2, 3))
+    x = jnp.asarray(np.random.randn(2, 3).astype(np.float32))
+    relu = ElementUnary("r", t, "relu")
+    assert np.all(np.asarray(relu.forward({}, [x], ctx32())[0]) >= 0)
+    add = ElementBinary("a", t, Tensor((2, 3)), "add")
+    np.testing.assert_allclose(
+        np.asarray(add.forward({}, [x, x], ctx32())[0]),
+        2 * np.asarray(x), rtol=1e-6)
+
+
+def test_embedding_gather():
+    t = Tensor((4,), dtype="int32")
+    op = Embedding("emb", t, 10, 6)
+    params = init_params(op)
+    idx = jnp.asarray([0, 3, 3, 9], jnp.int32)
+    y = np.asarray(op.forward(params, [idx], ctx32())[0])
+    table = np.asarray(params[op.w_table.name])
+    np.testing.assert_allclose(y[1], table[3], rtol=1e-6)
+    np.testing.assert_allclose(y, table[[0, 3, 3, 9]], rtol=1e-6)
+
+
+def test_batchnorm_normalizes():
+    t = Tensor((8, 4, 2, 2))
+    op = BatchNorm("bn", t, relu=False)
+    params = init_params(op)
+    x = jnp.asarray(np.random.randn(8, 4, 2, 2).astype(np.float32) * 3 + 1)
+    ctx = ctx32(training=True)
+    y = np.asarray(op.forward(params, [x], ctx)[0])
+    assert abs(y.mean()) < 1e-4
+    assert abs(y.std() - 1.0) < 1e-2
+    assert op.s_mean.name in ctx.updates  # running stats updated
+
+
+def test_batchnorm_inference_uses_running_stats():
+    t = Tensor((4, 2, 2, 2))
+    op = BatchNorm("bn", t, relu=False)
+    params = init_params(op)
+    x = jnp.ones((4, 2, 2, 2))
+    y = np.asarray(op.forward(params, [x], ctx32(training=False))[0])
+    # running mean 0, var 1 -> identity
+    np.testing.assert_allclose(y, np.ones_like(y), rtol=1e-4)
+
+
+def test_layernorm_rmsnorm():
+    t = Tensor((2, 5, 8))
+    ln = LayerNorm("ln", t)
+    rn = RMSNorm("rn", t)
+    x = jnp.asarray(np.random.randn(2, 5, 8).astype(np.float32))
+    yl = np.asarray(ln.forward(init_params(ln), [x], ctx32())[0])
+    np.testing.assert_allclose(yl.mean(-1), np.zeros((2, 5)), atol=1e-5)
+    yr = np.asarray(rn.forward(init_params(rn), [x], ctx32())[0])
+    assert yr.shape == (2, 5, 8)
+
+
+def test_dropout_train_vs_eval():
+    t = Tensor((100, 100))
+    op = Dropout("do", t, 0.5)
+    x = jnp.ones((100, 100))
+    y_train = np.asarray(op.forward({}, [x], ctx32(training=True))[0])
+    y_eval = np.asarray(op.forward({}, [x], ctx32(training=False))[0])
+    np.testing.assert_allclose(y_eval, np.ones((100, 100)))
+    frac_zero = (y_train == 0).mean()
+    assert 0.4 < frac_zero < 0.6
+    assert abs(y_train.mean() - 1.0) < 0.1  # inverted dropout scaling
