@@ -45,29 +45,68 @@ def mean_squared_error(preds: jax.Array, labels: jax.Array) -> jax.Array:
     return jnp.mean(jnp.sum(jnp.square(d), axis=tuple(range(1, d.ndim))))
 
 
+def _per_example_scce(logits, labels):
+    labels = labels.reshape(labels.shape[0]).astype(jnp.int32)
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    ll = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return logz - ll
+
+
+def _per_example_cce(probs, labels):
+    probs = probs.astype(jnp.float32)
+    return -jnp.sum(labels * jnp.log(probs + 1e-8), axis=-1)
+
+
+def _per_example_sq(preds, labels):
+    d = preds.astype(jnp.float32) - labels.astype(jnp.float32)
+    return jnp.sum(jnp.square(d).reshape(d.shape[0], -1), axis=-1)
+
+
+def _per_example_sq_mean(preds, labels):
+    d = preds.astype(jnp.float32) - labels.astype(jnp.float32)
+    return jnp.mean(jnp.square(d).reshape(d.shape[0], -1), axis=-1)
+
+
+# per-example loss + batch reduction ("mean" over samples or "sum").
+# The scalar loss used for training grads is reduction(per_example).
 _LOSSES = {
-    SPARSE_CATEGORICAL_CROSSENTROPY: sparse_categorical_crossentropy,
-    CATEGORICAL_CROSSENTROPY: categorical_crossentropy,
-    MEAN_SQUARED_ERROR: mean_squared_error,
-    MEAN_SQUARED_ERROR_AVG_REDUCE: lambda p, l: jnp.mean(
-        jnp.square(p.astype(jnp.float32) - l.astype(jnp.float32))),
-    MEAN_SQUARED_ERROR_SUM_REDUCE: lambda p, l: jnp.sum(
-        jnp.square(p.astype(jnp.float32) - l.astype(jnp.float32))),
+    SPARSE_CATEGORICAL_CROSSENTROPY: (_per_example_scce, "mean"),
+    CATEGORICAL_CROSSENTROPY: (_per_example_cce, "mean"),
+    MEAN_SQUARED_ERROR: (_per_example_sq, "mean"),
+    MEAN_SQUARED_ERROR_AVG_REDUCE: (_per_example_sq_mean, "mean"),
+    MEAN_SQUARED_ERROR_SUM_REDUCE: (_per_example_sq, "sum"),
 }
 
 
-def get_loss_fn(loss_type: str):
-    # keras-style aliases
-    alias = {
-        "sparse_crossentropy": SPARSE_CATEGORICAL_CROSSENTROPY,
-        "scce": SPARSE_CATEGORICAL_CROSSENTROPY,
-        "cce": CATEGORICAL_CROSSENTROPY,
-        "mse": MEAN_SQUARED_ERROR,
-    }
-    loss_type = alias.get(loss_type, loss_type)
+_ALIASES = {
+    "sparse_crossentropy": SPARSE_CATEGORICAL_CROSSENTROPY,
+    "scce": SPARSE_CATEGORICAL_CROSSENTROPY,
+    "cce": CATEGORICAL_CROSSENTROPY,
+    "mse": MEAN_SQUARED_ERROR,
+}
+
+
+def _canon(loss_type: str) -> str:
+    loss_type = _ALIASES.get(loss_type, loss_type)
     if loss_type not in _LOSSES:
         raise ValueError(f"unknown loss {loss_type!r}")
-    return _LOSSES[loss_type]
+    return loss_type
+
+
+def get_per_example_loss_fn(loss_type: str):
+    """(per_example_fn, reduction) — per-row losses for masked evaluation."""
+    return _LOSSES[_canon(loss_type)]
+
+
+def get_loss_fn(loss_type: str):
+    per_ex, reduction = _LOSSES[_canon(loss_type)]
+    red = jnp.mean if reduction == "mean" else jnp.sum
+
+    def fn(preds, labels):
+        return red(per_ex(preds, labels))
+
+    return fn
 
 
 def uses_logits(loss_type: str) -> bool:

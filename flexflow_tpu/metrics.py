@@ -74,35 +74,48 @@ class PerfMetrics:
 
 def compute_batch_metrics(preds: jax.Array, labels: jax.Array,
                           metric_names: Sequence[str],
-                          loss_type: str) -> Dict[str, jax.Array]:
+                          loss_type: str,
+                          nvalid=None) -> Dict[str, jax.Array]:
     """Per-batch metric *sums* (not means) so the host fold matches the
     reference's accumulate-then-divide semantics
-    (metrics_functions.cu:58-160)."""
-    out: Dict[str, jax.Array] = {"count": jnp.asarray(preds.shape[0], jnp.int32)}
+    (metrics_functions.cu:58-160).  ``nvalid`` masks out padded tail rows:
+    only the first ``nvalid`` samples contribute."""
+    bs = preds.shape[0]
+    if nvalid is None:
+        mask = jnp.ones((bs,), jnp.float32)
+        count = jnp.asarray(bs, jnp.int32)
+    else:
+        mask = (jnp.arange(bs) < nvalid).astype(jnp.float32)
+        count = jnp.asarray(nvalid, jnp.int32)
+    out: Dict[str, jax.Array] = {"count": count}
     pf = preds.astype(jnp.float32)
     for m in metric_names:
         if m == ACCURACY:
             if labels.ndim == 1 or labels.shape[-1] == 1:
                 lab = labels.reshape(labels.shape[0]).astype(jnp.int32)
                 pred_cls = jnp.argmax(pf, axis=-1).astype(jnp.int32)
-                out["correct"] = jnp.sum(pred_cls == lab).astype(jnp.int32)
+                hit = (pred_cls == lab)
             else:
-                out["correct"] = jnp.sum(
-                    jnp.argmax(pf, -1) == jnp.argmax(labels, -1)).astype(jnp.int32)
+                hit = (jnp.argmax(pf, -1) == jnp.argmax(labels, -1))
+            out["correct"] = jnp.sum(hit * mask).astype(jnp.int32)
         elif m == SPARSE_CATEGORICAL_CROSSENTROPY:
             lab = labels.reshape(labels.shape[0]).astype(jnp.int32)
             logp = jax.nn.log_softmax(pf, axis=-1)
             out["scce"] = -jnp.sum(
-                jnp.take_along_axis(logp, lab[:, None], axis=-1))
+                jnp.take_along_axis(logp, lab[:, None], axis=-1)[:, 0] * mask)
         elif m == CATEGORICAL_CROSSENTROPY:
-            out["cce"] = -jnp.sum(labels * jnp.log(pf + 1e-8))
+            out["cce"] = -jnp.sum(
+                jnp.sum(labels * jnp.log(pf + 1e-8), axis=-1) * mask)
         elif m == MEAN_SQUARED_ERROR:
             out["mse"] = jnp.sum(
-                jnp.mean(jnp.square(pf - labels), axis=tuple(range(1, pf.ndim))))
+                jnp.mean(jnp.square(pf - labels), axis=tuple(range(1, pf.ndim)))
+                * mask)
         elif m == ROOT_MEAN_SQUARED_ERROR:
             out["rmse"] = jnp.sum(jnp.sqrt(
-                jnp.mean(jnp.square(pf - labels), axis=tuple(range(1, pf.ndim)))))
+                jnp.mean(jnp.square(pf - labels), axis=tuple(range(1, pf.ndim))))
+                * mask)
         elif m == MEAN_ABSOLUTE_ERROR:
             out["mae"] = jnp.sum(
-                jnp.mean(jnp.abs(pf - labels), axis=tuple(range(1, pf.ndim))))
+                jnp.mean(jnp.abs(pf - labels), axis=tuple(range(1, pf.ndim)))
+                * mask)
     return out

@@ -245,6 +245,18 @@ class FFModel:
         self.metrics = list(metrics or self.metrics or [])
         self.comp_mode = comp_mode
         self._final_tensor = final_tensor or self.layers[-1].outputs[0]
+        # Reference-parity fused softmax-CE contract: the reference's loss
+        # task consumes the Softmax op's *output* but computes the fused
+        # gradient (softmax - onehot) as if on logits
+        # (loss_functions.cu:36-74, softmax.cu:216-218).  Our sparse-CCE is
+        # the fused logit form, so when the graph ends in an explicit Softmax
+        # the loss must read the Softmax *input* — otherwise CE is applied to
+        # probabilities (double softmax).  Predictions keep the softmax output.
+        self._loss_tensor = self._final_tensor
+        if (losses_mod.uses_logits(self.loss_type)
+                and self._final_tensor.owner_op is not None
+                and self._final_tensor.owner_op.op_type == OpType.SOFTMAX):
+            self._loss_tensor = self._final_tensor.owner_op.inputs[0]
 
         # --- strategy resolution (reference compile step 1) ---
         if cfg.import_strategy_file:
@@ -331,10 +343,9 @@ class FFModel:
         trainable = {p.name for p in self.parameters if p.trainable}
         return trainable
 
-    def _forward_logits(self, params, batch_inputs, ctx):
-        values = self._execute(params, batch_inputs, ctx, constrain=(
+    def _forward_values(self, params, batch_inputs, ctx):
+        return self._execute(params, batch_inputs, ctx, constrain=(
             self.mesh is not None and self.mesh.is_distributed))
-        return values[self._final_tensor.uid]
 
     def _build_step_fns(self) -> None:
         cfg = self.config
@@ -343,13 +354,15 @@ class FFModel:
         metric_names = self.metrics
         loss_type = self.loss_type
         input_uids = [t.uid for t in self.input_tensors]
+        loss_uid = self._loss_tensor.uid
+        final_uid = self._final_tensor.uid
 
         def forward_full(params, batch, rng, training):
             ctx = OpContext(training=training, rng=rng,
                             compute_dtype=cfg.compute_dtype, mesh=self.mesh)
             inputs = {uid: x for uid, x in zip(input_uids, batch[:-1])}
-            logits = self._forward_logits(params, inputs, ctx)
-            return logits, ctx.updates
+            values = self._forward_values(params, inputs, ctx)
+            return values[loss_uid], values[final_uid], ctx.updates
 
         if cfg.remat:
             forward_full = jax.checkpoint(forward_full,
@@ -357,12 +370,12 @@ class FFModel:
 
         def loss_and_metrics(trainable, frozen, batch, rng):
             params = {**frozen, **trainable}
-            logits, updates = forward_full(params, batch, rng, True)
+            logits, preds, updates = forward_full(params, batch, rng, True)
             labels = batch[-1]
             loss = loss_fn(logits, labels)
             sums = metrics_mod.compute_batch_metrics(
                 logits, labels, metric_names, loss_type)
-            return loss, (updates, logits, sums)
+            return loss, (updates, preds, sums)
 
         grad_fn = jax.value_and_grad(loss_and_metrics, has_aux=True)
 
@@ -379,20 +392,27 @@ class FFModel:
             new_params = {**frozen, **updates, **new_trainable}
             return new_params, new_opt_state, loss, sums
 
-        def eval_step(params, batch):
-            logits, _ = forward_full(params, batch, None, False)
+        per_ex_fn, loss_reduction = losses_mod.get_per_example_loss_fn(
+            self.loss_type)
+        self._loss_reduction = loss_reduction
+
+        def eval_step(params, batch, nvalid):
+            """Masked eval: only the first ``nvalid`` rows (padded tail
+            batches) contribute to loss/metric sums."""
+            logits, preds, _ = forward_full(params, batch, None, False)
             labels = batch[-1]
-            loss = loss_fn(logits, labels)
+            mask = (jnp.arange(logits.shape[0]) < nvalid).astype(jnp.float32)
+            loss_sum = jnp.sum(per_ex_fn(logits, labels) * mask)
             sums = metrics_mod.compute_batch_metrics(
-                logits, labels, metric_names, loss_type)
-            return logits, loss, sums
+                logits, labels, metric_names, loss_type, nvalid=nvalid)
+            return preds, loss_sum, sums
 
         donate = (0, 1)
         self._train_step = jax.jit(train_step, donate_argnums=donate)
         self._eval_step = jax.jit(eval_step)
         # parity verbs need un-fused pieces
         self._jit_forward = jax.jit(
-            lambda params, batch: forward_full(params, batch, None, False)[0])
+            lambda params, batch: forward_full(params, batch, None, False)[1])
         self._jit_grads = jax.jit(
             lambda params, batch, step: grad_fn(
                 {k: v for k, v in params.items() if k in trainable_names},
@@ -560,35 +580,52 @@ class FFModel:
             cb.on_train_end()
         return self.perf_metrics
 
+    @staticmethod
+    def _pad_tail(arrays, bs: int):
+        """Zero-pad a ragged tail batch to the full batch size so the jitted
+        step sees a static shape (and sharded batch dims stay divisible)."""
+        out = []
+        for a in arrays:
+            a = np.asarray(a)
+            short = bs - a.shape[0]
+            if short > 0:
+                a = np.concatenate(
+                    [a, np.zeros((short,) + a.shape[1:], a.dtype)])
+            out.append(a)
+        return tuple(out)
+
     def evaluate(self, x, y, batch_size: Optional[int] = None):
         bs = batch_size or self.config.batch_size
         xs = x if isinstance(x, (list, tuple)) else [x]
         n = xs[0].shape[0]
         pm = metrics_mod.PerfMetrics()
-        total_loss, nb = 0.0, 0
-        for it in range(n // bs):
-            sl = slice(it * bs, (it + 1) * bs)
-            batch = tuple(self._shard_batch(
-                tuple(a[sl] for a in xs) + (y[sl],)))
-            logits, loss, sums = self._eval_step(self._params, batch)
-            total_loss += float(loss)
-            nb += 1
+        loss_sum, total = 0.0, 0
+        for it in range(-(-n // bs)):
+            lo, hi = it * bs, min(n, (it + 1) * bs)
+            arrs = self._pad_tail(
+                tuple(a[lo:hi] for a in xs) + (y[lo:hi],), bs)
+            batch = tuple(self._shard_batch(arrs))
+            _, bloss, sums = self._eval_step(self._params, batch, hi - lo)
+            loss_sum += float(bloss)
+            total += hi - lo
             pm.update({k: np.asarray(v) for k, v in sums.items()})
-        return total_loss / max(1, nb), pm
+        denom = max(1, total) if self._loss_reduction == "mean" else 1
+        return loss_sum / denom, pm
 
     def predict(self, x, batch_size: Optional[int] = None) -> np.ndarray:
         xs = x if isinstance(x, (list, tuple)) else [x]
         n = xs[0].shape[0]
+        bs = batch_size or self.config.batch_size
         dummy_label = np.zeros(
-            (n,) + tuple(self.label_tensor.shape[1:]),
+            (bs,) + tuple(self.label_tensor.shape[1:]),
             self.label_tensor.dtype)
         outs = []
-        bs = batch_size or self.config.batch_size
-        for it in range(max(1, n // bs)):
-            sl = slice(it * bs, min(n, (it + 1) * bs))
-            batch = tuple(self._shard_batch(
-                tuple(a[sl] for a in xs) + (dummy_label[sl],)))
-            outs.append(np.asarray(self._jit_forward(self._params, batch)))
+        for it in range(-(-n // bs)):
+            lo, hi = it * bs, min(n, (it + 1) * bs)
+            arrs = self._pad_tail(tuple(a[lo:hi] for a in xs), bs)
+            batch = tuple(self._shard_batch(arrs + (dummy_label,)))
+            out = np.asarray(self._jit_forward(self._params, batch))
+            outs.append(out[:hi - lo])
         return np.concatenate(outs, axis=0)
 
     # ------------------------------------------------------------------

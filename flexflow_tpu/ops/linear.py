@@ -92,8 +92,9 @@ class Embedding(Op):
         return [cast_compute(y, ctx)]
 
     def parallel_dims(self):
-        # sample dim only (reference embedding.cu:116)
-        return (True, False)
+        # sample dim + out-dim: the table shards over the out-dim
+        # (reference embedding.cu:95-103 via create_linear_weight)
+        return (True, True)
 
     def flops(self):
         return self.outputs[0].volume

@@ -9,9 +9,7 @@ else accept with probability ``exp(-alpha * delta)``; budget/alpha from the
 Mesh-expressibility: candidate configs are drawn from axis-aligned
 factorizations of the device count over the canonical mesh axes
 (n/c/h/w/s), the constraint under which GSPMD can realize any joint
-assignment (SURVEY §7 "hard parts").  A C++ implementation of the hot
-simulate+propose loop lives in flexflow_tpu/native (used when built); this
-module is the always-available reference implementation and the entry point.
+assignment (SURVEY §7 "hard parts").
 """
 
 from __future__ import annotations
@@ -85,15 +83,6 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
            overlap_backward_update: bool = False,
            verbose: bool = False) -> Tuple[Dict[str, ParallelConfig], float]:
     """Run the annealing loop; returns (best strategies, best sim time)."""
-    # try the native C++ hot loop first
-    try:
-        from ..native import ffi as native_ffi
-        if native_ffi.available():
-            return native_ffi.mcmc_search(
-                layers, num_devices, budget, alpha, seed, spec,
-                overlap_backward_update, verbose)
-    except ImportError:
-        pass
     return _py_search(layers, num_devices, budget, alpha, seed, spec,
                       measure, overlap_backward_update, verbose)
 
