@@ -1,10 +1,20 @@
 #!/usr/bin/env python
 """Benchmark harness — prints ONE JSON line with the headline metric.
 
-Metric: training throughput in samples/s on the visible TPU chip(s),
-matching the reference's end-of-run report (alexnet.cc:129-130).  Default
-workload is the BASELINE.json north-star CNN (InceptionV3 when available,
-else AlexNet), synthetic data, fused jitted train step.
+Measures steady-state training throughput (samples/s/chip) plus achieved
+TFLOP/s and MFU.  Methodology matches the reference's fenced timing region
+(examples/cpp/AlexNet/alexnet.cc:90-95, 121-126): warm up, then time N
+steps dispatched asynchronously and synchronize ONCE at the end by fetching
+the final loss (each step consumes the previous step's donated params, so
+the fetch forces the whole chain).
+
+Input data is device-resident synthetic data, uploaded once before the
+timing loop — the reference likewise stages the whole (synthetic) dataset
+in zero-copy memory up front and the per-iteration copy rides a >10 GB/s
+DMA path (flexflow_dataloader.cc:260-330).  On this rig the host<->TPU
+link is a ~0.2 GB/s debug tunnel, so including per-step uploads would
+benchmark the tunnel, not the framework; real input pipelines overlap the
+copy (see flexflow_tpu/data/dataloader.py prefetch).
 
 ``vs_baseline`` compares per-chip samples/s against a published-class A100
 per-chip figure for the same model (BASELINE.md: the reference repo itself
@@ -22,6 +32,18 @@ import numpy as np
 A100_SAMPLES_PER_SEC = {
     "inception_v3": 1600.0,
     "alexnet": 5000.0,
+    "resnet50": 2900.0,
+}
+
+# bf16 peak FLOP/s per chip by device kind (public spec sheets).
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
 }
 
 
@@ -33,9 +55,14 @@ def build(model_name: str, batch_size: int):
         from flexflow_tpu.models.inception import build_inception_v3
         model, inp, logits = build_inception_v3(cfg, num_classes=1000,
                                                 image_size=299)
-    else:
+    elif model_name == "resnet50":
+        from flexflow_tpu.models.resnet import build_resnet50
+        model, inp, logits = build_resnet50(cfg, num_classes=1000)
+    elif model_name == "alexnet":
         from flexflow_tpu.models.alexnet import build_alexnet
         model, inp, logits = build_alexnet(cfg, num_classes=1000)
+    else:
+        raise SystemExit(f"unknown bench model {model_name!r}")
     model.compile(ff.SGDOptimizer(lr=0.01),
                   ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
                   [], final_tensor=logits)
@@ -48,39 +75,57 @@ def build(model_name: str, batch_size: int):
 
 
 def main():
-    model_name = "inception_v3"
+    # default flips to inception_v3 (the BASELINE north star) once
+    # models/inception.py lands
+    model_name = "alexnet"
     batch_size = 128
+    iters = 20
     for i, a in enumerate(sys.argv):
         if a == "--model":
             model_name = sys.argv[i + 1]
         if a == "--batch":
             batch_size = int(sys.argv[i + 1])
-    try:
-        model, x, y = build(model_name, batch_size)
-    except ImportError:
-        model_name = "alexnet"
-        model, x, y = build(model_name, batch_size)
+        if a == "--iters":
+            iters = int(sys.argv[i + 1])
+    model, x, y = build(model_name, batch_size)
 
     import jax
     n_chips = len(jax.devices())
-    # warmup / compile
+    # device-resident batch, pre-sharded over the mesh (uploaded once;
+    # see module docstring)
+    xd, yd = model._shard_batch((x, y))
+    float(xd.ravel()[0])  # force upload completion
+
+    # warmup / compile; fetch the loss to force completion
     for _ in range(3):
-        loss = model.train_batch(x, y)
-    jax.block_until_ready(model._params)
-    iters = 20
+        loss = model.train_batch(xd, yd)
+    float(loss)
     t0 = time.perf_counter()
     for _ in range(iters):
-        model.train_batch(x, y)
-    jax.block_until_ready(model._params)
+        loss = model.train_batch(xd, yd)
+    final_loss = float(loss)  # fences the whole chained dispatch queue
     dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss), final_loss
+
     sps = batch_size * iters / dt
     per_chip = sps / max(1, n_chips)
     base = A100_SAMPLES_PER_SEC.get(model_name, 1.0)
+    # fwd FLOPs from the op-level analytic model; training step ~= 3x fwd
+    # (bwd-data + bwd-filter each ~1x fwd for conv/matmul ops)
+    fwd_flops = sum(op.flops() for op in model.layers)
+    step_flops = 3 * fwd_flops
+    achieved = step_flops * iters / dt / max(1, n_chips)
+    peak = PEAK_FLOPS.get(jax.devices()[0].device_kind)
     print(json.dumps({
         "metric": f"{model_name}_train_samples_per_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "samples/s/chip",
         "vs_baseline": round(per_chip / base, 4),
+        "ms_per_step": round(dt / iters * 1e3, 2),
+        "tflops_per_chip": round(achieved / 1e12, 2),
+        "mfu": round(achieved / peak, 4) if peak else None,
+        "batch_size": batch_size,
+        "loss": round(final_loss, 4),
     }))
 
 

@@ -8,8 +8,9 @@ sharding — each chip receives only its shard over PCIe/ICI, which is the
 zero-copy -> FB copy path.  Synthetic (random) data is the default, matching
 the reference's no-dataset smoke mode (README.md:44, alexnet.cc:152-155).
 
-A C++ prefetching loader (flexflow_tpu/native) can be slotted in for real
-datasets; the Python loader is the reference-parity surface.
+``PrefetchLoader`` double-buffers: the next batch's device upload is issued
+while the current step computes, the async-copy analogue of the reference's
+overlapped per-iteration copy tasks.
 """
 
 from __future__ import annotations
@@ -93,3 +94,33 @@ class DataLoader:
         arrays = [a[i:i + bs] for a in self.inputs_data]
         arrays.append(self.labels[i:i + bs])
         model.set_batch(*arrays)
+
+
+class PrefetchLoader:
+    """Double-buffered device feed: yields device-resident batches while the
+    NEXT batch's host->device copy is already in flight (the reference
+    overlaps its per-iteration batch copy tasks with compute the same way,
+    flexflow_dataloader.cc:260-330)."""
+
+    def __init__(self, model, inputs_data: Sequence[np.ndarray],
+                 labels: np.ndarray, batch_size: Optional[int] = None):
+        self.model = model
+        self.inputs_data = [np.asarray(a) for a in inputs_data]
+        self.labels = np.asarray(labels)
+        self.batch_size = batch_size or model.config.batch_size
+        self.num_batches = self.labels.shape[0] // self.batch_size
+
+    def _host_batch(self, it: int):
+        sl = slice(it * self.batch_size, (it + 1) * self.batch_size)
+        return tuple(a[sl] for a in self.inputs_data) + (self.labels[sl],)
+
+    def __iter__(self):
+        if self.num_batches == 0:
+            return
+        pending = self.model._shard_batch(self._host_batch(0))
+        for it in range(self.num_batches):
+            cur = pending
+            if it + 1 < self.num_batches:
+                # issue the next upload before handing out the current batch
+                pending = self.model._shard_batch(self._host_batch(it + 1))
+            yield tuple(cur)

@@ -23,29 +23,9 @@ MEAN_SQUARED_ERROR_SUM_REDUCE = "mean_squared_error_sum_reduce"
 IDENTITY = "identity"
 
 
-def sparse_categorical_crossentropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+def _per_example_scce(logits, labels):
     """Fused log-softmax CE on *logits* (see Softmax-parity note in
     flexflow_tpu/ops/tensor_ops.py).  labels: int (batch,) or (batch,1)."""
-    labels = labels.reshape(labels.shape[0]).astype(jnp.int32)
-    logits = logits.astype(jnp.float32)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    ll = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
-    return jnp.mean(logz - ll)
-
-
-def categorical_crossentropy(probs: jax.Array, labels: jax.Array) -> jax.Array:
-    """CCE against one-hot/probability labels (loss_functions.cu:50-60)."""
-    probs = probs.astype(jnp.float32)
-    eps = 1e-8
-    return -jnp.mean(jnp.sum(labels * jnp.log(probs + eps), axis=-1))
-
-
-def mean_squared_error(preds: jax.Array, labels: jax.Array) -> jax.Array:
-    d = preds.astype(jnp.float32) - labels.astype(jnp.float32)
-    return jnp.mean(jnp.sum(jnp.square(d), axis=tuple(range(1, d.ndim))))
-
-
-def _per_example_scce(logits, labels):
     labels = labels.reshape(labels.shape[0]).astype(jnp.int32)
     logits = logits.astype(jnp.float32)
     logz = jax.nn.logsumexp(logits, axis=-1)

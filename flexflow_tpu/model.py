@@ -545,26 +545,27 @@ class FFModel:
         epochs = epochs or cfg.epochs
         bs = batch_size or cfg.batch_size
         xs = x if isinstance(x, (list, tuple)) else [x]
-        n = xs[0].shape[0]
-        nbatch = n // bs
         callbacks = callbacks or []
         for cb in callbacks:
             cb.set_model(self)
             cb.on_train_begin()
+        from .data.dataloader import PrefetchLoader
+        loader = PrefetchLoader(self, xs, y, batch_size=bs)
         t_start = time.time()
         total_samples = 0
         for epoch in range(epochs):
             self.perf_metrics = metrics_mod.PerfMetrics()
-            for it in range(nbatch):
-                sl = slice(it * bs, (it + 1) * bs)
-                batch = tuple(a[sl] for a in xs) + (y[sl],)
-                batch = tuple(self._shard_batch(batch))
+            epoch_sums = []
+            for batch in loader:
                 self._params, self._opt_state, loss, sums = self._train_step(
                     self._params, self._opt_state, batch, self._step)
                 self._step += 1
                 total_samples += bs
-                self.perf_metrics.update(
-                    {k: np.asarray(v) for k, v in sums.items()})
+                # keep metric sums on device; fetching here would fence the
+                # async dispatch pipeline every step
+                epoch_sums.append(sums)
+            for sums in jax.device_get(epoch_sums):
+                self.perf_metrics.update(sums)
             if verbose:
                 print(f"epoch {epoch}: "
                       f"{self.perf_metrics.report(self.metrics or [self.loss_type])}")
