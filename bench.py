@@ -75,9 +75,8 @@ def build(model_name: str, batch_size: int):
 
 
 def main():
-    # default flips to inception_v3 (the BASELINE north star) once
-    # models/inception.py lands
-    model_name = "alexnet"
+    # the BASELINE north-star workload
+    model_name = "inception_v3"
     batch_size = 128
     iters = 20
     for i, a in enumerate(sys.argv):

@@ -296,11 +296,16 @@ class FFModel:
 
     def _infer_mesh_shape(self) -> Dict[str, int]:
         """Derive mesh axis sizes from resolved per-op strategies: each
-        canonical axis takes the max degree any op assigns to it; leftover
-        devices go to the data axis."""
+        canonical axis is sized to the LCM of the degrees ops assign to it
+        (every degree then divides the axis and maps onto sub-axes —
+        mesh.MachineMesh), falling back to the max degree when the LCM
+        overshoots the device count."""
+        import math
+
         from .parallel.mesh import dim_axis_names
         ndev = len(jax.devices())
-        sizes = {"n": 1, "c": 1, "h": 1, "w": 1, "s": 1}
+        lcm = {"n": 1, "c": 1, "h": 1, "w": 1, "s": 1}
+        mx = dict(lcm)
         any_cfg = False
         for op in self.layers:
             pc = op.parallel_config
@@ -309,14 +314,17 @@ class FFModel:
             any_cfg = True
             axes = dim_axis_names(len(pc.dims))
             for deg, ax in zip(pc.dims, axes):
-                if ax and deg > sizes[ax]:
-                    sizes[ax] = deg
+                if ax and deg > 1:
+                    lcm[ax] = math.lcm(lcm[ax], deg)
+                    mx[ax] = max(mx[ax], deg)
         if not any_cfg:
             return {"n": ndev}
-        used = int(np.prod(list(sizes.values())))
+        if int(np.prod(list(lcm.values()))) <= ndev:
+            return lcm
+        used = int(np.prod(list(mx.values())))
         if used > ndev:
             raise ValueError(f"strategy needs {used} devices, have {ndev}")
-        return sizes
+        return mx
 
     # ------------------------------------------------------------------
     # execution engine
@@ -489,7 +497,13 @@ class FFModel:
             a = jnp.asarray(a)
             if self.mesh is not None and self.mesh.is_distributed:
                 spec = batch_spec(a.ndim, self.mesh)
-                a = jax.device_put(a, self.mesh.sharding(spec))
+                # non-divisible dims replicate (the reference likewise backs
+                # off to a dividing parallelism degree, model.cc:263-274)
+                entries = [ax if ax is None or
+                           a.shape[i] % self.mesh.axis_size(ax) == 0 else None
+                           for i, ax in enumerate(spec)]
+                a = jax.device_put(
+                    a, self.mesh.sharding(jax.sharding.PartitionSpec(*entries)))
             out.append(a)
         return out
 

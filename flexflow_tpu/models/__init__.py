@@ -1,1 +1,3 @@
 from .alexnet import build_alexnet
+from .inception import build_inception_v3
+from .resnet import build_resnet50

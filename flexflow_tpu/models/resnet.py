@@ -1,0 +1,64 @@
+"""ResNet-50 (reference ``examples/cpp/ResNet/resnet.cc``).
+
+Bottleneck residual blocks built from conv2d + elementwise add
+(resnet.cc:34-47): 1x1 reduce, 3x3, 1x1 expand, with a strided/projecting
+shortcut when the shape changes.  The residual ``add`` is the ElementBinary
+op — XLA fuses it into the preceding conv's epilogue on TPU.
+
+The reference omits BatchNorm (its blocks are conv-only); we match that
+topology by default so FLOPs/parameter counts line up, with an opt-in
+``batch_norm=True`` for the torchvision-style variant.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from ..config import FFConfig
+from ..model import FFModel
+from ..tensor import Tensor
+
+
+def _bottleneck(ff: FFModel, x: Tensor, out_channels: int, stride: int,
+                batch_norm: bool = False) -> Tensor:
+    t = ff.conv2d(x, out_channels, 1, 1, 1, 1, 0, 0, activation="relu")
+    if batch_norm:
+        t = ff.batch_norm(t)
+    t = ff.conv2d(t, out_channels, 3, 3, stride, stride, 1, 1,
+                  activation="relu")
+    if batch_norm:
+        t = ff.batch_norm(t)
+    t = ff.conv2d(t, 4 * out_channels, 1, 1, 1, 1, 0, 0)
+    if batch_norm:
+        t = ff.batch_norm(t, relu=False)
+    if stride > 1 or x.shape[1] != 4 * out_channels:
+        x = ff.conv2d(x, 4 * out_channels, 1, 1, stride, stride, 0, 0,
+                      activation="relu")
+    return ff.add(x, t)
+
+
+def build_resnet50(config: FFConfig, num_classes: int = 10,
+                   image_size: int = 229,
+                   batch_norm: bool = False) -> Tuple[FFModel, Tensor, Tensor]:
+    """Stage plan per resnet.cc:79-100: conv7x7/2, maxpool/2, then
+    3/4/6/3 bottleneck blocks at 64/128/256/512 channels."""
+    ff = FFModel(config)
+    inp = ff.create_tensor(
+        (config.batch_size, 3, image_size, image_size), name="input")
+    t = ff.conv2d(inp, 64, 7, 7, 2, 2, 3, 3)
+    t = ff.pool2d(t, 3, 3, 2, 2, 1, 1)
+    for _ in range(3):
+        t = _bottleneck(ff, t, 64, 1, batch_norm)
+    for i in range(4):
+        t = _bottleneck(ff, t, 128, 2 if i == 0 else 1, batch_norm)
+    for i in range(6):
+        t = _bottleneck(ff, t, 256, 2 if i == 0 else 1, batch_norm)
+    for i in range(3):
+        t = _bottleneck(ff, t, 512, 2 if i == 0 else 1, batch_norm)
+    hw = t.shape[2]
+    t = ff.pool2d(t, hw, hw, 1, 1, 0, 0, pool_type="avg")
+    t = ff.flat(t)
+    t = ff.dense(t, num_classes)
+    logits = t
+    t = ff.softmax(t)
+    return ff, inp, logits

@@ -38,8 +38,70 @@ _ALIAS = {"data": "n", "batch": "n", "model": "c", "tensor": "c",
           "seq": "s", "sequence": "s", "expert": "c", "pipeline": "h"}
 
 
+def prime_factors(n: int) -> Tuple[int, ...]:
+    """Ascending prime factorization (with multiplicity)."""
+    out = []
+    d = 2
+    while d * d <= n:
+        while n % d == 0:
+            out.append(d)
+            n //= d
+        d += 1
+    if n > 1:
+        out.append(n)
+    return tuple(out)
+
+
+def subset_for_degree(factors: Sequence[int], degree: int):
+    """Indices of a sub-multiset of ``factors`` whose product == degree,
+    preferring a prefix (keeps producer/consumer shardings aligned).
+    Returns None when no subset works."""
+    if degree == 1:
+        return ()
+    prod, pref = 1, []
+    for i, f in enumerate(factors):
+        prod *= f
+        pref.append(i)
+        if prod == degree:
+            return tuple(pref)
+        if prod > degree:
+            break
+    # general subset DFS
+    def dfs(i, rem, picked):
+        if rem == 1:
+            return tuple(picked)
+        if i >= len(factors):
+            return None
+        if rem % factors[i] == 0:
+            r = dfs(i + 1, rem // factors[i], picked + [i])
+            if r is not None:
+                return r
+        return dfs(i + 1, rem, picked)
+
+    return dfs(0, degree, [])
+
+
+def expressible_degrees(size: int) -> Tuple[int, ...]:
+    """All degrees realizable as sub-multiset products of size's primes
+    (== all divisors of ``size``), ascending."""
+    factors = prime_factors(size)
+    degs = {1}
+    for f in factors:
+        degs |= {d * f for d in degs}
+    return tuple(sorted(degs))
+
+
 class MachineMesh:
-    """A named jax Mesh over the visible devices (or an explicit list)."""
+    """A named jax Mesh over the visible devices (or an explicit list).
+
+    Each canonical axis is materialized as its prime-factor *sub-axes*
+    (axis "n" of size 8 -> mesh axes n0,n1,n2 of size 2 each), so an op may
+    shard a dim with ANY divisor degree of the axis size — the mixed
+    per-op degrees of SOAP strategies (reference
+    Op::get_random_parallel_config, model.cc:276-305) map to sub-axis
+    subsets instead of being rejected.  A PartitionSpec entry that names a
+    canonical axis is expanded to all its sub-axes by :meth:`sharding`.
+    """
 
     def __init__(self, shape: Optional[Dict[str, int]] = None,
                  devices: Optional[Sequence[jax.Device]] = None):
@@ -56,9 +118,24 @@ class MachineMesh:
             raise ValueError(f"mesh {sizes} needs {used} devices, "
                              f"have {len(devices)}")
         devices = devices[:used]
-        dev_array = np.array(devices).reshape([sizes[a] for a in AXES])
         self.sizes = sizes
-        self.mesh = Mesh(dev_array, AXES)
+        self._subaxes: Dict[str, Tuple[str, ...]] = {}
+        self._subfactors: Dict[str, Tuple[int, ...]] = {}
+        names: list = []
+        dims: list = []
+        for a in AXES:
+            fs = prime_factors(sizes[a]) if sizes[a] > 1 else ()
+            subs = tuple(f"{a}{i}" for i in range(len(fs)))
+            self._subaxes[a] = subs
+            self._subfactors[a] = fs
+            names.extend(subs)
+            dims.extend(fs)
+        if not names:  # single device still needs a valid Mesh
+            names, dims = ["n0"], [1]
+            self._subaxes["n"] = ("n0",)
+            self._subfactors["n"] = (1,)
+        dev_array = np.array(devices).reshape(dims)
+        self.mesh = Mesh(dev_array, tuple(names))
         self.num_devices = used
 
     @property
@@ -68,8 +145,33 @@ class MachineMesh:
     def axis_size(self, axis: str) -> int:
         return self.sizes[_ALIAS.get(axis, axis)]
 
+    def axis_spec(self, axis: str, degree: int):
+        """Sub-axis name tuple realizing ``degree`` shards on ``axis``;
+        the full canonical name when degree == axis size; None when the
+        degree is not a realizable divisor."""
+        a = _ALIAS.get(axis, axis)
+        if degree <= 1:
+            return ()
+        if degree == self.sizes[a]:
+            return self._subaxes[a]
+        idx = subset_for_degree(self._subfactors[a], degree)
+        if idx is None:
+            return None
+        return tuple(self._subaxes[a][i] for i in idx)
+
+    def _expand(self, entry):
+        if entry is None:
+            return None
+        if isinstance(entry, str):
+            subs = self._subaxes.get(_ALIAS.get(entry, entry))
+            if subs is not None:  # canonical axis name -> all sub-axes
+                return subs if len(subs) > 0 else None
+            return entry  # already a sub-axis name
+        return tuple(entry) or None
+
     def sharding(self, spec: PartitionSpec) -> NamedSharding:
-        return NamedSharding(self.mesh, spec)
+        entries = tuple(self._expand(e) for e in spec)
+        return NamedSharding(self.mesh, PartitionSpec(*entries))
 
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, PartitionSpec())

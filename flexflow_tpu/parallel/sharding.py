@@ -10,12 +10,18 @@ Legion region movement (producer/consumer partition mismatch -> resharding;
 TP partial-grad replicas -> psum; DP grad replicas -> psum in backward).
 
 Mesh-expressibility contract (SURVEY §7 "hard parts"): a config degree for
-logical dim i must equal 1 or the mesh axis size for that dim's canonical
-axis.  The strategy search is constrained to this space.
+logical dim i must be a divisor of the mesh axis size for that dim's
+canonical axis — the mesh factors each axis into prime sub-axes
+(mesh.MachineMesh), so any divisor degree maps to a sub-axis subset; a
+degree that is NOT a realizable divisor falls back to replication with a
+warning instead of crashing the trace (a strategy file from the reference
+may encode placements GSPMD cannot express; running them replicated is the
+honest degrade).
 """
 
 from __future__ import annotations
 
+import warnings
 from typing import Optional
 
 from jax.sharding import PartitionSpec
@@ -41,18 +47,17 @@ def output_spec(tensor: Tensor, pc: Optional[ParallelConfig],
         dims = tuple(dims[:rank]) + (1,) * max(0, rank - len(dims))
     entries = []
     for i, (deg, ax) in enumerate(zip(dims, axes)):
-        if deg <= 1 or ax is None:
+        if deg <= 1 or ax is None or tensor.shape[i] % deg != 0:
             entries.append(None)
             continue
-        asize = mesh.axis_size(ax)
-        if deg != asize:
-            raise ValueError(
+        sub = mesh.axis_spec(ax, deg)
+        if sub is None:
+            warnings.warn(
                 f"{tensor.name}: degree {deg} on dim {i} not expressible on "
-                f"mesh axis {ax!r} (size {asize})")
-        if tensor.shape[i] % deg != 0:
+                f"mesh axis {ax!r} (size {mesh.axis_size(ax)}); replicating")
             entries.append(None)
             continue
-        entries.append(ax)
+        entries.append(ax if deg == mesh.axis_size(ax) else sub)
     return PartitionSpec(*entries)
 
 
@@ -72,15 +77,17 @@ def param_spec(param: Parameter, pc: Optional[ParallelConfig],
     for deg, ax in zip(pc.dims, axes):
         if ax == "c":
             c_deg = deg
-    if c_deg <= 1:
+    if c_deg <= 1 or param.shape[param.sharded_dim] % c_deg != 0:
         return PartitionSpec()
-    if c_deg != mesh.axis_size("c"):
-        raise ValueError(f"{param.name}: channel degree {c_deg} != mesh c "
-                         f"axis {mesh.axis_size('c')}")
-    if param.shape[param.sharded_dim] % c_deg != 0:
+    sub = mesh.axis_spec("c", c_deg)
+    if sub is None:
+        warnings.warn(f"{param.name}: channel degree {c_deg} not expressible "
+                      f"on mesh c axis (size {mesh.axis_size('c')}); "
+                      f"replicating")
         return PartitionSpec()
     entries = [None] * len(param.shape)
-    entries[param.sharded_dim] = "c"
+    entries[param.sharded_dim] = ("c" if c_deg == mesh.axis_size("c")
+                                  else sub)
     return PartitionSpec(*entries)
 
 

@@ -6,10 +6,13 @@ mutation to a random legal config, accept if the simulated runtime improves,
 else accept with probability ``exp(-alpha * delta)``; budget/alpha from the
 ``--budget`` / ``--alpha`` flags (model.cc:1253-1260).
 
-Mesh-expressibility: candidate configs are drawn from axis-aligned
-factorizations of the device count over the canonical mesh axes
-(n/c/h/w/s), the constraint under which GSPMD can realize any joint
-assignment (SURVEY §7 "hard parts").
+Executability contract: the search fixes a *global mesh factorization* of
+the device count over the canonical axes (n/c/h/w/s) as part of its state,
+and per-op degrees are drawn from the divisors of the chosen axis sizes —
+exactly the space MachineMesh's prime sub-axes can realize (mesh.py), so
+every strategy this module returns compiles and runs.  A proposal either
+mutates one op (the reference's ``rewrite``) or re-factorizes the mesh and
+snaps all op configs into the new axis sizes.
 """
 
 from __future__ import annotations
@@ -20,8 +23,11 @@ from typing import Dict, List, Optional, Tuple
 
 from ..config import FFConfig, ParallelConfig
 from ..op import Op
+from ..parallel.mesh import AXES, dim_axis_names, expressible_degrees
 from .cost_model import DEFAULT_SPEC, DeviceSpec
 from .simulator import Simulator
+
+MeshShape = Dict[str, int]
 
 
 def _factorizations(n: int, slots: int) -> List[Tuple[int, ...]]:
@@ -38,36 +44,10 @@ def _factorizations(n: int, slots: int) -> List[Tuple[int, ...]]:
     return out
 
 
-def legal_configs(op: Op, num_devices: int,
-                  max_candidates: int = 64) -> List[ParallelConfig]:
-    """Legal mesh-expressible configs for one op (reference
-    Op::get_random_parallel_config, model.cc:276-305, which samples
-    factorizations of the device count over the op's partitionable dims)."""
-    out_t = op.outputs[0]
-    nd = out_t.num_dims
-    allowed = op.parallel_dims()
-    cands: List[ParallelConfig] = []
-    for total in {d for d in range(1, num_devices + 1) if num_devices % d == 0}:
-        for dims in _factorizations(total, nd):
-            ok = True
-            for i, deg in enumerate(dims):
-                if deg > 1 and (i >= len(allowed) or not allowed[i]):
-                    ok = False
-                    break
-                if deg > 1 and out_t.shape[i] % deg != 0:
-                    ok = False
-                    break
-            if ok:
-                cands.append(ParallelConfig(
-                    dims=dims, device_ids=tuple(range(_prod(dims)))))
-    # dedupe, cap
-    seen = set()
-    uniq = []
-    for c in cands:
-        if c.dims not in seen:
-            seen.add(c.dims)
-            uniq.append(c)
-    return uniq[:max_candidates]
+def candidate_meshes(num_devices: int) -> List[MeshShape]:
+    """Factorizations of the device count over the canonical axes."""
+    return [dict(zip(AXES, f))
+            for f in _factorizations(num_devices, len(AXES))]
 
 
 def _prod(xs) -> int:
@@ -77,63 +57,139 @@ def _prod(xs) -> int:
     return n
 
 
+def legal_configs(op: Op, mesh_shape: MeshShape,
+                  max_candidates: int = 64) -> List[ParallelConfig]:
+    """Legal configs for one op under a fixed mesh factorization: each
+    output dim's degree is a divisor of its canonical axis size (all
+    divisors are sub-axis-expressible) that also divides the dim extent
+    (reference Op::get_random_parallel_config, model.cc:276-305)."""
+    out_t = op.outputs[0]
+    nd = out_t.num_dims
+    allowed = op.parallel_dims()
+    axes = dim_axis_names(nd)
+    per_dim: List[Tuple[int, ...]] = []
+    for i in range(nd):
+        ax = axes[i] if i < len(axes) else None
+        if (ax is None or i >= len(allowed) or not allowed[i]
+                or mesh_shape.get(ax, 1) <= 1):
+            per_dim.append((1,))
+            continue
+        degs = tuple(d for d in expressible_degrees(mesh_shape[ax])
+                     if out_t.shape[i] % d == 0)
+        per_dim.append(degs or (1,))
+    import itertools
+
+    return [ParallelConfig(dims=dims, device_ids=tuple(range(_prod(dims))))
+            for dims in itertools.islice(
+                itertools.product(*per_dim), max_candidates)]
+
+
+def snap_config(pc: ParallelConfig, op: Op,
+                mesh_shape: MeshShape) -> ParallelConfig:
+    """Clamp an op config into a mesh factorization: keep each degree if it
+    divides the new axis size (and the dim extent), else fall back to the
+    largest expressible divisor of both."""
+    out_t = op.outputs[0]
+    axes = dim_axis_names(out_t.num_dims)
+    dims = []
+    for i, deg in enumerate(pc.dims[:out_t.num_dims]):
+        ax = axes[i] if i < len(axes) else None
+        if deg <= 1 or ax is None:
+            dims.append(1)
+            continue
+        best = 1
+        for d in expressible_degrees(mesh_shape.get(ax, 1)):
+            if deg % d == 0 and out_t.shape[i] % d == 0:
+                best = max(best, d)
+        dims.append(best)
+    dims += [1] * (out_t.num_dims - len(dims))
+    return ParallelConfig(dims=tuple(dims),
+                          device_ids=tuple(range(_prod(dims))))
+
+
 def search(layers: List[Op], num_devices: int, budget: int = 1000,
            alpha: float = 0.05, seed: int = 0,
            spec: DeviceSpec = DEFAULT_SPEC, measure: bool = False,
            overlap_backward_update: bool = False,
-           verbose: bool = False) -> Tuple[Dict[str, ParallelConfig], float]:
-    """Run the annealing loop; returns (best strategies, best sim time)."""
-    return _py_search(layers, num_devices, budget, alpha, seed, spec,
-                      measure, overlap_backward_update, verbose)
-
-
-def _py_search(layers, num_devices, budget, alpha, seed, spec, measure,
-               overlap_backward_update, verbose):
+           verbose: bool = False
+           ) -> Tuple[Dict[str, ParallelConfig], MeshShape, float]:
+    """Run the annealing loop; returns (best strategies, best mesh
+    factorization, best simulated time)."""
     rng = random.Random(seed)
     sim = Simulator(spec=spec, num_devices=num_devices, measure=measure)
-    cand_cache = {op.name: legal_configs(op, num_devices) for op in layers}
-    searchable = [op for op in layers if cand_cache[op.name]]
+    meshes = candidate_meshes(num_devices)
 
-    # start from data parallelism (model.cc:1020-1027)
+    def dp_mesh() -> MeshShape:
+        return {a: (num_devices if a == "n" else 1) for a in AXES}
+
+    # start from data parallelism on an all-data mesh (model.cc:1020-1027)
+    mesh_shape = dp_mesh()
+    cand_cache: Dict[Tuple[str, Tuple[int, ...]], List[ParallelConfig]] = {}
+
+    def cands(op: Op, ms: MeshShape) -> List[ParallelConfig]:
+        key = (op.name, tuple(ms[a] for a in AXES))
+        if key not in cand_cache:
+            cand_cache[key] = legal_configs(op, ms)
+        return cand_cache[key]
+
     current: Dict[str, ParallelConfig] = {}
     for op in layers:
         nd = op.outputs[0].num_dims
-        deg = num_devices
-        while deg > 1 and op.outputs[0].shape[0] % deg != 0:
-            deg //= 2
+        # largest expressible divisor of the n axis that divides the batch
+        deg = max((d for d in expressible_degrees(num_devices)
+                   if op.outputs[0].shape[0] % d == 0), default=1)
         current[op.name] = ParallelConfig.data_parallel(deg, nd)
     cur_time = sim.simulate(layers, current, overlap_backward_update)
-    best, best_time = dict(current), cur_time
+    best, best_mesh, best_time = dict(current), dict(mesh_shape), cur_time
     for it in range(budget):
-        op = rng.choice(searchable)
-        new_cfg = rng.choice(cand_cache[op.name])
-        if new_cfg.dims == current[op.name].dims:
-            continue
-        proposal = dict(current)
-        proposal[op.name] = new_cfg
+        if len(meshes) > 1 and rng.random() < 0.1:
+            # re-factorize the mesh; snap every op into the new axis sizes
+            new_mesh = rng.choice(meshes)
+            if tuple(new_mesh.values()) == tuple(mesh_shape.values()):
+                continue
+            proposal = {op.name: snap_config(current[op.name], op, new_mesh)
+                        for op in layers}
+            prop_mesh = new_mesh
+        else:
+            op = rng.choice(layers)
+            choices = cands(op, mesh_shape)
+            if not choices:
+                continue
+            new_cfg = rng.choice(choices)
+            if new_cfg.dims == current[op.name].dims:
+                continue
+            proposal = dict(current)
+            proposal[op.name] = new_cfg
+            prop_mesh = mesh_shape
         new_time = sim.simulate(layers, proposal, overlap_backward_update)
         delta = new_time - cur_time
         if delta < 0 or (math.isfinite(new_time) and
                          rng.random() < math.exp(-alpha * delta * 1e3)):
-            current, cur_time = proposal, new_time
+            current, cur_time, mesh_shape = proposal, new_time, prop_mesh
             if cur_time < best_time:
-                best, best_time = dict(current), cur_time
+                best, best_mesh, best_time = (dict(current), dict(mesh_shape),
+                                              cur_time)
                 if verbose:
                     print(f"[search] iter {it}: {best_time * 1e3:.3f} ms")
-    return best, best_time
+    return best, best_mesh, best_time
 
 
 def optimize_strategies(model, cfg: FFConfig) -> Dict[str, ParallelConfig]:
     """Entry point used by FFModel.compile when ``--budget > 0``
-    (reference model.cc:953-966 launching STRATEGY_SEARCH_TASK)."""
+    (reference model.cc:953-966 launching STRATEGY_SEARCH_TASK).  Also
+    pins ``cfg.mesh_shape`` to the searched factorization so compile()
+    builds the mesh the strategies were scored against."""
     import jax
 
     ndev = cfg.num_devices if cfg.workers_per_node else len(jax.devices())
-    best, best_time = search(
+    best, best_mesh, best_time = search(
         model.layers, ndev, budget=cfg.search_budget,
         alpha=cfg.search_alpha, seed=cfg.seed,
         measure=(cfg.simulator_mode == "measure"),
         overlap_backward_update=cfg.search_overlap_backward_update)
     print(f"[search] best simulated iteration time: {best_time * 1e3:.3f} ms "
-          f"on {ndev} devices")
+          f"on {ndev} devices, mesh "
+          f"{ {a: s for a, s in best_mesh.items() if s > 1} }")
+    if cfg.mesh_shape is None:
+        cfg.mesh_shape = {a: s for a, s in best_mesh.items() if s > 1}
     return best

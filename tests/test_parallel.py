@@ -138,6 +138,35 @@ def test_output_spec_mesh_expressibility():
     spec = output_spec(t, ParallelConfig(dims=(4, 2),
                                          device_ids=tuple(range(8))), mesh)
     assert tuple(spec) == ("n", "c")
-    with pytest.raises(ValueError):
-        output_spec(t, ParallelConfig(dims=(2, 2),
-                                      device_ids=tuple(range(4))), mesh)
+    # mixed degree < axis size maps onto a prime sub-axis subset
+    spec = output_spec(t, ParallelConfig(dims=(2, 2),
+                                         device_ids=tuple(range(4))), mesh)
+    assert tuple(spec) == ("n0", "c")  # sub-axis subset of the n axis
+    # a non-divisor degree degrades to replication with a warning
+    t3 = Tensor((30, 64))
+    with pytest.warns(UserWarning):
+        spec = output_spec(t3, ParallelConfig(dims=(3, 1),
+                                              device_ids=(0, 1, 2)), mesh)
+    assert tuple(spec) == (None, None)
+
+
+def test_mixed_degree_strategy_executes():
+    """The VERDICT repro: conv (4,1,1,1) + dense (8,1) in one model used to
+    crash at trace time (Weak#3); sub-axis sharding must run it."""
+    cfg = ff.FFConfig(batch_size=8, compute_dtype="float32")
+    cfg.strategies = {
+        "conv2d": ParallelConfig(dims=(4, 1, 1, 1), device_ids=(0, 1, 2, 3)),
+        "dense": ParallelConfig(dims=(8, 1), device_ids=tuple(range(8))),
+    }
+    model = ff.FFModel(cfg, mesh=MachineMesh({"n": 8}))
+    x = model.create_tensor((8, 3, 16, 16), name="img")
+    t = model.conv2d(x, 8, 3, 3, 1, 1, 1, 1, activation="relu")
+    t = model.flat(t)
+    t = model.dense(t, 4)
+    model.compile(ff.SGDOptimizer(lr=0.01), "sparse_categorical_crossentropy",
+                  [], final_tensor=t)
+    model.init_layers()
+    rng = np.random.default_rng(0)
+    xd = rng.standard_normal((8, 3, 16, 16), dtype=np.float32)
+    yd = rng.integers(0, 4, (8, 1)).astype(np.int32)
+    assert np.isfinite(float(model.train_batch(xd, yd)))

@@ -68,18 +68,57 @@ def test_simulator_dp_faster_than_serial():
 
 def test_legal_configs_respect_divisibility():
     layers = _mlp_layers(batch=6)  # 6 not divisible by 4 or 8
-    for cfg in legal_configs(layers[0], 8):
+    mesh = {"n": 8, "c": 1, "h": 1, "w": 1, "s": 1}
+    for cfg in legal_configs(layers[0], mesh):
         assert 6 % cfg.dims[0] == 0 or cfg.dims[0] == 1
+        # degree must divide the axis size it maps onto
+        assert 8 % cfg.dims[0] == 0
 
 
 def test_mcmc_improves_over_start():
     layers = _mlp_layers()
-    best, best_time = search(layers, num_devices=8, budget=60, seed=0)
+    best, best_mesh, best_time = search(layers, num_devices=8, budget=60,
+                                        seed=0)
     sim = Simulator(num_devices=8)
     dp = {op.name: ParallelConfig.data_parallel(8, op.outputs[0].num_dims)
           for op in layers}
     t_dp = sim.simulate(layers, dp)
     assert best_time <= t_dp * 1.001
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_searched_strategy_always_executes(seed):
+    """Property (VERDICT Weak#3): EVERY strategy returned by search()
+    compiles and executes a train step on the 8-device CPU mesh — the
+    search space and the executor's legality must agree."""
+    import warnings
+
+    batch = 16
+    cfg = ff.FFConfig(batch_size=batch, compute_dtype="float32")
+    model = ff.FFModel(cfg)
+    x = model.create_tensor((batch, 3, 16, 16), name="img")
+    t = model.conv2d(x, 8, 3, 3, 1, 1, 1, 1, activation="relu")
+    t = model.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = model.flat(t)
+    t = model.dense(t, 32, activation="relu")
+    t = model.dense(t, 8)
+    best, best_mesh, _ = search(model.layers, num_devices=8, budget=40,
+                                seed=seed)
+    cfg.strategies.update(best)
+    mesh = ff.MachineMesh({a: s for a, s in best_mesh.items() if s > 1})
+    for op in model.layers:
+        op.parallel_config = cfg.strategies.get(op.name)
+    model.mesh = mesh
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # no silent replication downgrades
+        model.compile(ff.SGDOptimizer(lr=0.05),
+                      "sparse_categorical_crossentropy", [], final_tensor=t,
+                      mesh=mesh)
+        model.init_layers(seed=0)
+        rng = np.random.default_rng(seed)
+        xd = rng.standard_normal((batch, 3, 16, 16), dtype=np.float32)
+        yd = rng.integers(0, 8, (batch, 1)).astype(np.int32)
+        assert np.isfinite(float(model.train_batch(xd, yd)))
 
 
 def test_compile_with_search_budget_and_export(tmp_path):
