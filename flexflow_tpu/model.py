@@ -124,6 +124,26 @@ class FFModel:
                        num_entries, out_dim, aggr, kernel_initializer)
         return self._register(op).outputs[0]
 
+    def multihead_attention(self, query, key=None, value=None, embed_dim=None,
+                            num_heads=8, kdim=0, vdim=0, dropout=0.0,
+                            bias=True, causal=False, kernel_initializer=None,
+                            name=None) -> Tensor:
+        from .ops.attention import MultiHeadAttention
+        key = key if key is not None else query
+        value = value if value is not None else key
+        embed_dim = embed_dim or query.shape[-1]
+        op = MultiHeadAttention(self._uname("attention", name), query, key,
+                                value, embed_dim, num_heads, kdim, vdim,
+                                dropout, bias, causal, kernel_initializer)
+        return self._register(op).outputs[0]
+
+    def position_embedding(self, input_tensor, max_len=None,
+                           kernel_initializer=None, name=None) -> Tensor:
+        from .ops.attention import PositionEmbedding
+        op = PositionEmbedding(self._uname("pos_embedding", name),
+                               input_tensor, max_len, kernel_initializer)
+        return self._register(op).outputs[0]
+
     def flat(self, input_tensor, name=None) -> Tensor:
         return self._register(Flat(self._uname("flat", name), input_tensor)).outputs[0]
 
@@ -496,7 +516,14 @@ class FFModel:
         for a in arrays:
             a = jnp.asarray(a)
             if self.mesh is not None and self.mesh.is_distributed:
-                spec = batch_spec(a.ndim, self.mesh)
+                # dim 1 is a sequence dim only for (n, s) token ids or
+                # (n, s, d) activations — never for image (n,c,h,w) inputs
+                seq_shaped = (a.ndim == 3
+                              or (a.ndim == 2
+                                  and jnp.issubdtype(a.dtype, jnp.integer)))
+                spec = batch_spec(a.ndim, self.mesh,
+                                  seq_sharded=(seq_shaped and
+                                               self.mesh.axis_size("s") > 1))
                 # non-divisible dims replicate (the reference likewise backs
                 # off to a dividing parallelism degree, model.cc:263-274)
                 entries = [ax if ax is None or

@@ -1,3 +1,4 @@
 from .alexnet import build_alexnet
 from .inception import build_inception_v3
 from .resnet import build_resnet50
+from .transformer import build_transformer

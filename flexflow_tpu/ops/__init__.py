@@ -1,3 +1,4 @@
+from .attention import MultiHeadAttention, PositionEmbedding
 from .conv import Conv2D, Pool2D
 from .elementwise import ElementBinary, ElementUnary
 from .linear import Embedding, Linear

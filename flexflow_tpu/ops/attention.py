@@ -1,0 +1,262 @@
+"""MultiHeadAttention + sequence-parallel ring attention.
+
+The reference has **no attention ops** (SURVEY §5 "no attention ops exist");
+this is the new workload BASELINE.json config 5 adds.  Design is TPU-first:
+
+* the dense path is one fused chain of einsums (QKV projection → scores →
+  softmax → context → output projection) that XLA maps onto the MXU, with
+  float32 softmax statistics;
+* the sequence-parallel path is **ring attention**: query blocks stay
+  resident on their shard of the ``s`` mesh axis while key/value blocks
+  rotate around the ring via ``lax.ppermute``, combined with an online
+  (flash-style) softmax so the full score matrix never materializes.  This
+  is the long-context scaling story the reference lacks entirely — its only
+  sequence partitioning is NMT timestep *pipelining* (nmt/rnn.h:23).
+
+Gradients for the ring path come from jax autodiff through the
+``shard_map``-ed scan (ppermute is linear; its transpose is the reverse
+rotation), so there is no hand-written backward.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from ..initializers import GlorotUniform, ZeroInitializer
+from ..op import Op, OpContext, OpType
+from .common import cast_compute
+
+NEG_INF = -1e30  # finite mask value: keeps online-softmax exp() NaN-free
+
+
+def _dense_attention(q, k, v, causal: bool, scale: float,
+                     dropout_rate: float, rng):
+    """(n,sq,h,d),(n,sk,h,d),(n,sk,h,d) -> (n,sq,h,d); f32 softmax."""
+    scores = jnp.einsum("nqhd,nkhd->nhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        sq, sk = scores.shape[2], scores.shape[3]
+        qpos = jnp.arange(sq)[:, None]
+        kpos = jnp.arange(sk)[None, :]
+        scores = jnp.where(kpos > qpos, NEG_INF, scores)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_rate > 0.0 and rng is not None:
+        keep = 1.0 - dropout_rate
+        mask = jax.random.bernoulli(rng, keep, probs.shape)
+        probs = jnp.where(mask, probs / keep, 0.0)
+    return jnp.einsum("nhqk,nkhd->nqhd", probs.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32)
+
+
+def _ring_attention_local(q, k, v, rng, *, s_axes, ring_size: int,
+                          s_local: int, causal: bool, scale: float,
+                          dropout_rate: float = 0.0):
+    """Per-shard ring attention body (runs inside shard_map).
+
+    q,k,v: (n, s_local, h, d) — this device's sequence block.  KV blocks
+    rotate around the ring; an online softmax (running max ``m``, running
+    denominator ``l``, unnormalized accumulator ``o``) merges each block's
+    contribution, so peak memory is O(s_local^2) scores per step instead of
+    O(s_local * s_global).
+    """
+    idx = jax.lax.axis_index(s_axes)
+    n, sq, h, d = q.shape
+    qf = q.astype(jnp.float32)
+    m0 = jnp.full((n, h, sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((n, h, sq), jnp.float32)
+    o0 = jnp.zeros((n, sq, h, d), jnp.float32)
+    perm = [(j, (j - 1) % ring_size) for j in range(ring_size)]
+    qpos = idx * s_local + jnp.arange(sq)
+
+    def body(carry, step):
+        kb, vb, m, l, o = carry
+        src = (idx + step) % ring_size  # owner of the block we now hold
+        scores = jnp.einsum("nqhd,nkhd->nhqk", qf, kb.astype(jnp.float32),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            kpos = src * s_local + jnp.arange(kb.shape[1])
+            scores = jnp.where(kpos[None, None, None, :]
+                               > qpos[None, None, :, None], NEG_INF, scores)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[..., None])
+        # the denominator accumulates the UNdropped p, so masking p only in
+        # the numerator is exactly dense attention's dropout-after-softmax
+        # (dropout commutes with the 1/l normalization)
+        l_new = l * corr + p.sum(axis=-1)
+        pv = p
+        if dropout_rate > 0.0 and rng is not None:
+            key = jax.random.fold_in(jax.random.fold_in(rng, idx), step)
+            keep = 1.0 - dropout_rate
+            mask = jax.random.bernoulli(key, keep, p.shape)
+            pv = jnp.where(mask, p / keep, 0.0)
+        o_new = (o * jnp.transpose(corr, (0, 2, 1))[..., None]
+                 + jnp.einsum("nhqk,nkhd->nqhd", pv, vb.astype(jnp.float32),
+                              preferred_element_type=jnp.float32))
+        kb = jax.lax.ppermute(kb, s_axes, perm)
+        vb = jax.lax.ppermute(vb, s_axes, perm)
+        return (kb, vb, m_new, l_new, o_new), None
+
+    (_, _, _, l, o), _ = jax.lax.scan(
+        body, (k, v, m0, l0, o0), jnp.arange(ring_size))
+    return o / jnp.transpose(l, (0, 2, 1))[..., None]
+
+
+def ring_attention(q, k, v, mesh, causal: bool, scale: float,
+                   dropout_rate: float = 0.0, rng=None):
+    """Sequence-parallel attention over the mesh's ``s`` axis.
+
+    q,k,v: (n, s, h, d) global arrays (sequence-sharded by GSPMD); the
+    shard_map runs one ring per (n-shard, s-ring) with heads replicated.
+    """
+    s_axes = mesh.subaxes("s")
+    n_axes = mesh.subaxes("n")
+    ring_size = mesh.axis_size("s")
+    s_local = q.shape[1] // ring_size
+    n_sharded = bool(n_axes) and q.shape[0] % mesh.axis_size("n") == 0
+    spec = PartitionSpec(n_axes if n_sharded else None, s_axes, None, None)
+    fn = partial(_ring_attention_local, s_axes=s_axes, ring_size=ring_size,
+                 s_local=s_local, causal=causal, scale=scale,
+                 dropout_rate=dropout_rate if rng is not None else 0.0)
+    if rng is None:
+        wrapped = lambda q, k, v: fn(q, k, v, None)  # noqa: E731
+        return jax.shard_map(wrapped, mesh=mesh.mesh,
+                             in_specs=(spec, spec, spec), out_specs=spec,
+                             check_vma=False)(q, k, v)
+    return jax.shard_map(fn, mesh=mesh.mesh,
+                         in_specs=(spec, spec, spec, PartitionSpec()),
+                         out_specs=spec, check_vma=False)(q, k, v, rng)
+
+
+class MultiHeadAttention(Op):
+    """Reference-parity builder signature (the later FlexFlow generations
+    expose ``multihead_attention(query, key, value, embed_dim, num_heads,
+    ...)``); this snapshot has none, so the surface follows that convention.
+
+    Weights follow Linear's (out, in) layout: wq/wk/wv project the model dim
+    to ``num_heads*head_dim`` and are sharded over their out-dim on the
+    ``c`` (tensor-parallel) mesh axis — Megatron-style head parallelism;
+    wo projects back and shards over its *in* dim.
+    """
+
+    op_type = OpType.ATTENTION
+
+    def __init__(self, name, query, key, value, embed_dim, num_heads,
+                 kdim=0, vdim=0, dropout=0.0, use_bias=True, causal=False,
+                 kernel_initializer=None):
+        inputs = [query] if key is query and value is query else [
+            query, key, value]
+        super().__init__(name, inputs)
+        self.embed_dim, self.num_heads = embed_dim, num_heads
+        # kdim/vdim follow torch.nn.MultiheadAttention: the feature dims of
+        # the key/value inputs — they must match the actual tensors
+        self.kdim = kdim or key.shape[-1]
+        self.vdim = vdim or value.shape[-1]
+        assert self.kdim == key.shape[-1], (self.kdim, key.shape)
+        assert self.vdim == value.shape[-1], (self.vdim, value.shape)
+        assert embed_dim % num_heads == 0, (embed_dim, num_heads)
+        self.head_dim = embed_dim // num_heads
+        self.dropout, self.causal, self.use_bias = float(dropout), causal, use_bias
+        self._self_attn = len(inputs) == 1
+        n, sq, dq = query.shape
+        self._add_output((n, sq, embed_dim), query.dtype)
+        init = kernel_initializer or GlorotUniform()
+        self.w_q = self._add_weight((embed_dim, dq), init, "wq", sharded_dim=0)
+        self.w_k = self._add_weight((embed_dim, key.shape[-1]), init, "wk",
+                                    sharded_dim=0)
+        self.w_v = self._add_weight((embed_dim, value.shape[-1]), init, "wv",
+                                    sharded_dim=0)
+        self.w_o = self._add_weight((embed_dim, embed_dim), init, "wo",
+                                    sharded_dim=1)
+        if use_bias:
+            self.w_bias = self._add_weight((embed_dim,), ZeroInitializer(),
+                                           "bias")
+
+    def _wants_ring(self, ctx: OpContext) -> bool:
+        pc = self.parallel_config
+        mesh = ctx.mesh
+        if mesh is None or mesh.axis_size("s") <= 1 or not self._self_attn:
+            return False
+        s_deg = pc.dims[1] if pc is not None and len(pc.dims) >= 2 else (
+            mesh.axis_size("s"))
+        return (s_deg == mesh.axis_size("s")
+                and self.inputs[0].shape[1] % s_deg == 0)
+
+    def forward(self, params, inputs, ctx: OpContext):
+        xq = cast_compute(inputs[0], ctx)
+        xk = xq if self._self_attn else cast_compute(inputs[1], ctx)
+        xv = xq if self._self_attn else cast_compute(inputs[2], ctx)
+        n, sq, _ = xq.shape
+        h, hd = self.num_heads, self.head_dim
+
+        def proj(x, w):
+            y = jnp.einsum("nsi,oi->nso", x, cast_compute(params[w.name], ctx),
+                           preferred_element_type=jnp.float32)
+            return cast_compute(y, ctx).reshape(n, x.shape[1], h, hd)
+
+        q = proj(xq, self.w_q)
+        k = proj(xk, self.w_k)
+        v = proj(xv, self.w_v)
+        scale = 1.0 / math.sqrt(hd)
+        rng = None
+        if ctx.training and self.dropout > 0.0 and ctx.rng is not None:
+            rng = jax.random.fold_in(ctx.rng, self.outputs[0].uid)
+        if self._wants_ring(ctx):
+            attn = ring_attention(q, k, v, ctx.mesh, self.causal, scale,
+                                  self.dropout if ctx.training else 0.0, rng)
+        else:
+            attn = _dense_attention(q, k, v, self.causal, scale,
+                                    self.dropout if ctx.training else 0.0,
+                                    rng)
+        attn = cast_compute(attn, ctx).reshape(n, sq, self.embed_dim)
+        out = jnp.einsum("nsi,oi->nso", attn,
+                         cast_compute(params[self.w_o.name], ctx),
+                         preferred_element_type=jnp.float32)
+        if self.use_bias:
+            out = out + params[self.w_bias.name].astype(out.dtype)
+        return [cast_compute(out, ctx)]
+
+    def parallel_dims(self):
+        # (n, s, c): sample DP, sequence SP (ring), channel TP (heads)
+        return (True, True, True)
+
+    def flops(self):
+        n, s, d = self.outputs[0].shape
+        proj = 4 * 2 * n * s * d * d          # q,k,v,o projections
+        sk = self.inputs[0].shape[1] if self._self_attn else \
+            self.inputs[1].shape[1]
+        scores = 2 * 2 * n * s * sk * d       # qk^T and probs*v
+        return proj + scores
+
+
+class PositionEmbedding(Op):
+    """Learned absolute position table added to a (n, s, d) sequence
+    (transformer workload support; no reference analogue)."""
+
+    op_type = OpType.EMBEDDING
+
+    def __init__(self, name, input_tensor, max_len=None,
+                 kernel_initializer=None):
+        super().__init__(name, [input_tensor])
+        n, s, d = input_tensor.shape
+        self.max_len = max_len or s
+        assert self.max_len >= s, (self.max_len, s)
+        self._add_output((n, s, d), input_tensor.dtype)
+        self.w_table = self._add_weight(
+            (self.max_len, d), kernel_initializer or GlorotUniform(), "table")
+
+    def forward(self, params, inputs, ctx: OpContext):
+        x = inputs[0]
+        table = params[self.w_table.name][: x.shape[1]]
+        return [x + cast_compute(table, ctx)[None]]
+
+    def parallel_dims(self):
+        return (True, True, False)
+
+    def flops(self):
+        return self.outputs[0].volume

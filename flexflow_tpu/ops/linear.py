@@ -73,7 +73,13 @@ class Embedding(Op):
         super().__init__(name, [input_tensor])
         self.num_entries, self.out_dim, self.aggr = num_entries, out_dim, aggr
         n = input_tensor.shape[0]
-        self._add_output((n, out_dim), "float32")
+        if aggr in (None, "none"):
+            # sequence mode (transformer token embedding): keep every
+            # looked-up row — (n, s) ids -> (n, s, d)
+            self.aggr = "none"
+            self._add_output(input_tensor.shape + (out_dim,), "float32")
+        else:
+            self._add_output((n, out_dim), "float32")
         self.w_table = self._add_weight(
             (num_entries, out_dim), kernel_initializer or GlorotUniform(),
             "table", sharded_dim=1)
@@ -82,7 +88,7 @@ class Embedding(Op):
         idx = inputs[0].astype(jnp.int32)
         table = params[self.w_table.name]
         y = jnp.take(table, idx, axis=0)  # (n, [s,] d)
-        if y.ndim == 3:  # bag of indices per sample
+        if y.ndim == 3 and self.aggr != "none":  # bag of indices per sample
             if self.aggr == "sum":
                 y = y.sum(axis=1)
             elif self.aggr == "avg":
@@ -92,9 +98,10 @@ class Embedding(Op):
         return [cast_compute(y, ctx)]
 
     def parallel_dims(self):
-        # sample dim + out-dim: the table shards over the out-dim
-        # (reference embedding.cu:95-103 via create_linear_weight)
-        return (True, True)
+        # every dim: sample (+sequence in "none" mode) + out-dim — the table
+        # shards over the out-dim (reference embedding.cu:95-103 via
+        # create_linear_weight)
+        return (True,) * self.outputs[0].num_dims
 
     def flops(self):
         return self.outputs[0].volume

@@ -145,6 +145,10 @@ class MachineMesh:
     def axis_size(self, axis: str) -> int:
         return self.sizes[_ALIAS.get(axis, axis)]
 
+    def subaxes(self, axis: str) -> Tuple[str, ...]:
+        """The prime sub-axis names materializing a canonical axis."""
+        return self._subaxes.get(_ALIAS.get(axis, axis), ())
+
     def axis_spec(self, axis: str, degree: int):
         """Sub-axis name tuple realizing ``degree`` shards on ``axis``;
         the full canonical name when degree == axis size; None when the

@@ -1,0 +1,161 @@
+"""Transformer + attention tests: single-device training, ring-attention
+numerics (dense vs ring, causal and not), and DP/SP/TP parity on the
+8-device CPU mesh (BASELINE.json config 5; the reference has no attention
+ops — SURVEY §5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.config import ParallelConfig
+from flexflow_tpu.models.transformer import build_transformer
+from flexflow_tpu.ops.attention import _dense_attention, ring_attention
+from flexflow_tpu.parallel.mesh import MachineMesh
+
+
+def _data(b=8, s=16, vocab=100, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.integers(0, vocab, (b, s)).astype(np.int32)
+    y = rng.integers(0, classes, (b, 1)).astype(np.int32)
+    return x, y
+
+
+def _train(mesh_shape, strategies=None, steps=4, causal=False, seed=0):
+    cfg = ff.FFConfig(batch_size=8, compute_dtype="float32")
+    if strategies:
+        cfg.strategies = strategies
+    model, tokens, logits = build_transformer(
+        cfg, num_layers=2, d_model=64, num_heads=4, d_ff=128, seq_len=16,
+        vocab_size=100, num_classes=4, causal=causal)
+    model.compile(ff.SGDOptimizer(lr=0.05),
+                  ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY, [],
+                  final_tensor=logits, mesh=MachineMesh(mesh_shape))
+    model.init_layers(seed=seed)
+    x, y = _data()
+    return [float(model.train_batch(x, y)) for _ in range(steps)]
+
+
+def test_transformer_trains_single_device():
+    losses = _train({"n": 1}, steps=5)
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_transformer_dp_sp_parity():
+    """DP x ring-attention SP == single device (VERDICT next-round #7)."""
+    base = _train({"n": 1})
+    sp = {f"attention_{i}": ParallelConfig(dims=(2, 4, 1),
+                                           device_ids=tuple(range(8)))
+          for i in range(2)}
+    dpsp = _train({"n": 2, "s": 4}, sp)
+    np.testing.assert_allclose(base, dpsp, rtol=2e-4, atol=2e-4)
+
+
+def test_transformer_causal_dp_sp_parity():
+    """Causal masking must agree across the ring's block boundaries."""
+    base = _train({"n": 1}, causal=True)
+    sp = {f"attention_{i}": ParallelConfig(dims=(1, 8, 1),
+                                           device_ids=tuple(range(8)))
+          for i in range(2)}
+    spo = _train({"s": 8}, sp, causal=True)
+    np.testing.assert_allclose(base, spo, rtol=2e-4, atol=2e-4)
+
+
+def test_transformer_tp_parity():
+    """Head/FFN tensor parallelism over 'c' == single device."""
+    base = _train({"n": 1})
+    tp = {}
+    for i in range(2):
+        tp[f"attention_{i}"] = ParallelConfig(dims=(2, 1, 4),
+                                              device_ids=tuple(range(8)))
+        tp[f"ffn_up_{i}"] = ParallelConfig(dims=(2, 1, 4),
+                                           device_ids=tuple(range(8)))
+    dptp = _train({"n": 2, "c": 4}, tp)
+    np.testing.assert_allclose(base, dptp, rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_matches_dense():
+    """Direct kernel check: ring online-softmax == dense softmax attention,
+    both causal and not, including gradients."""
+    mesh = MachineMesh({"s": 4})
+    rng = np.random.default_rng(1)
+    q, k, v = (rng.standard_normal((2, 16, 2, 8)).astype(np.float32)
+               for _ in range(3))
+    for causal in (False, True):
+        dense = _dense_attention(jnp.asarray(q), jnp.asarray(k),
+                                 jnp.asarray(v), causal, 0.35, 0.0, None)
+        ring = ring_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                              mesh, causal, 0.35)
+        np.testing.assert_allclose(np.asarray(dense), np.asarray(ring),
+                                   rtol=1e-5, atol=1e-5)
+
+        def loss_dense(q):
+            return jnp.sum(_dense_attention(q, jnp.asarray(k), jnp.asarray(v),
+                                            causal, 0.35, 0.0, None) ** 2)
+
+        def loss_ring(q):
+            return jnp.sum(ring_attention(q, jnp.asarray(k), jnp.asarray(v),
+                                          mesh, causal, 0.35) ** 2)
+
+        gd = jax.grad(loss_dense)(jnp.asarray(q))
+        gr = jax.grad(loss_ring)(jnp.asarray(q))
+        np.testing.assert_allclose(np.asarray(gd), np.asarray(gr),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_ring_attention_nondivisible_batch_degrades():
+    """Batch not divisible by the n axis must fall back to a replicated
+    batch spec inside the ring, not crash at trace time."""
+    cfg = ff.FFConfig(batch_size=6, compute_dtype="float32")
+    cfg.strategies = {"attention_0": ParallelConfig(
+        dims=(1, 2, 1), device_ids=(0, 1))}
+    model, tokens, logits = build_transformer(
+        cfg, num_layers=1, d_model=32, num_heads=2, d_ff=64, seq_len=8,
+        vocab_size=50, num_classes=4)
+    model.compile(ff.SGDOptimizer(lr=0.05),
+                  ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY, [],
+                  final_tensor=logits, mesh=MachineMesh({"n": 4, "s": 2}))
+    model.init_layers(seed=0)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 50, (6, 8)).astype(np.int32)
+    y = rng.integers(0, 4, (6, 1)).astype(np.int32)
+    assert np.isfinite(float(model.train_batch(x, y)))
+
+
+def test_ring_attention_dropout_trains():
+    """The ring path must honor attention dropout (masks differ from the
+    dense path's RNG stream, so only finiteness + progress are asserted)."""
+    cfg = ff.FFConfig(batch_size=8, compute_dtype="float32")
+    cfg.strategies = {"attention_0": ParallelConfig(
+        dims=(1, 8, 1), device_ids=tuple(range(8)))}
+    model, tokens, logits = build_transformer(
+        cfg, num_layers=1, d_model=32, num_heads=2, d_ff=64, seq_len=16,
+        vocab_size=50, num_classes=4, dropout=0.2)
+    model.compile(ff.SGDOptimizer(lr=0.05),
+                  ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY, [],
+                  final_tensor=logits, mesh=MachineMesh({"s": 8}))
+    model.init_layers(seed=0)
+    x, y = _data(8, 16, 50)
+    losses = [float(model.train_batch(x, y)) for _ in range(6)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_searched_transformer_strategy_executes():
+    """MCMC search over the transformer graph returns executable strategies
+    (extends the round-1 legality property to the attention op)."""
+    cfg = ff.FFConfig(batch_size=8, compute_dtype="float32",
+                      search_budget=40, seed=3)
+    model, tokens, logits = build_transformer(
+        cfg, num_layers=1, d_model=32, num_heads=2, d_ff=64, seq_len=8,
+        vocab_size=50, num_classes=4)
+    model.compile(ff.SGDOptimizer(lr=0.05),
+                  ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY, [],
+                  final_tensor=logits)
+    model.init_layers(seed=0)
+    x, _ = _data(8, 8, 50)
+    y = np.zeros((8, 1), np.int32)
+    loss = float(model.train_batch(x, y))
+    assert np.isfinite(loss)
