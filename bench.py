@@ -50,6 +50,7 @@ PEAK_FLOPS = {
 def build(model_name: str, batch_size: int):
     import flexflow_tpu as ff
 
+    rng = np.random.default_rng(0)
     cfg = ff.FFConfig(batch_size=batch_size, compute_dtype="bfloat16")
     if model_name == "inception_v3":
         from flexflow_tpu.models.inception import build_inception_v3
@@ -61,6 +62,26 @@ def build(model_name: str, batch_size: int):
     elif model_name == "alexnet":
         from flexflow_tpu.models.alexnet import build_alexnet
         model, inp, logits = build_alexnet(cfg, num_classes=1000)
+    elif model_name == "transformer":
+        # BERT-base-class encoder (BASELINE.json config 5)
+        from flexflow_tpu.models.transformer import build_transformer
+        model, inp, logits = build_transformer(
+            cfg, num_layers=12, d_model=768, num_heads=12, d_ff=3072,
+            seq_len=512, vocab_size=30522, num_classes=2)
+    elif model_name == "nmt":
+        # reference nmt/nmt.cc:34-44 dims (embed/hidden 2048, vocab 20k)
+        from flexflow_tpu.models.nmt import build_nmt
+        model, inputs, logits = build_nmt(
+            cfg, vocab_size=20000, embed_dim=2048, hidden_dim=2048,
+            num_layers=2, src_len=24, tgt_len=24)
+        model.compile(ff.SGDOptimizer(lr=0.01),
+                      ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                      [], final_tensor=logits)
+        model.init_layers(seed=0)
+        xs = rng.integers(0, 20000, (batch_size, 24)).astype(np.int32)
+        xt = rng.integers(0, 20000, (batch_size, 24)).astype(np.int32)
+        y = np.roll(xt, -1, axis=1).astype(np.int32)
+        return model, (xs, xt), y
     else:
         raise SystemExit(f"unknown bench model {model_name!r}")
     model.compile(ff.SGDOptimizer(lr=0.01),
@@ -68,10 +89,13 @@ def build(model_name: str, batch_size: int):
                   [], final_tensor=logits)
     model.init_layers(seed=0)
     shape = inp.shape
-    rng = np.random.default_rng(0)
-    x = rng.standard_normal(shape, dtype=np.float32)
-    y = rng.integers(0, 1000, (shape[0], 1)).astype(np.int32)
-    return model, x, y
+    if model_name == "transformer":
+        x = rng.integers(0, 30522, shape).astype(np.int32)
+        y = rng.integers(0, 2, (shape[0], 1)).astype(np.int32)
+    else:
+        x = rng.standard_normal(shape, dtype=np.float32)
+        y = rng.integers(0, 1000, (shape[0], 1)).astype(np.int32)
+    return model, (x,), y
 
 
 def main():
@@ -86,29 +110,29 @@ def main():
             batch_size = int(sys.argv[i + 1])
         if a == "--iters":
             iters = int(sys.argv[i + 1])
-    model, x, y = build(model_name, batch_size)
+    model, xs, y = build(model_name, batch_size)
 
     import jax
     n_chips = len(jax.devices())
     # device-resident batch, pre-sharded over the mesh (uploaded once;
     # see module docstring)
-    xd, yd = model._shard_batch((x, y))
-    float(xd.ravel()[0])  # force upload completion
+    batch = model._shard_batch(tuple(xs) + (y,))
+    jax.block_until_ready(batch)
 
     # warmup / compile; fetch the loss to force completion
     for _ in range(3):
-        loss = model.train_batch(xd, yd)
+        loss = model.train_batch(*batch)
     float(loss)
     t0 = time.perf_counter()
     for _ in range(iters):
-        loss = model.train_batch(xd, yd)
+        loss = model.train_batch(*batch)
     final_loss = float(loss)  # fences the whole chained dispatch queue
     dt = time.perf_counter() - t0
     assert np.isfinite(final_loss), final_loss
 
     sps = batch_size * iters / dt
     per_chip = sps / max(1, n_chips)
-    base = A100_SAMPLES_PER_SEC.get(model_name, 1.0)
+    base = A100_SAMPLES_PER_SEC.get(model_name)
     # fwd FLOPs from the op-level analytic model; training step ~= 3x fwd
     # (bwd-data + bwd-filter each ~1x fwd for conv/matmul ops)
     fwd_flops = sum(op.flops() for op in model.layers)
@@ -119,7 +143,7 @@ def main():
         "metric": f"{model_name}_train_samples_per_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "samples/s/chip",
-        "vs_baseline": round(per_chip / base, 4),
+        "vs_baseline": round(per_chip / base, 4) if base else None,
         "ms_per_step": round(dt / iters * 1e3, 2),
         "tflops_per_chip": round(achieved / 1e12, 2),
         "mfu": round(achieved / peak, 4) if peak else None,

@@ -25,9 +25,16 @@ IDENTITY = "identity"
 
 def _per_example_scce(logits, labels):
     """Fused log-softmax CE on *logits* (see Softmax-parity note in
-    flexflow_tpu/ops/tensor_ops.py).  labels: int (batch,) or (batch,1)."""
-    labels = labels.reshape(labels.shape[0]).astype(jnp.int32)
+    flexflow_tpu/ops/tensor_ops.py).  labels: int (batch,) or (batch,1);
+    for sequence models logits (batch, seq, vocab) + labels (batch, seq)
+    give the per-example mean over tokens (the NMT per-token CE)."""
     logits = logits.astype(jnp.float32)
+    if logits.ndim == 3:
+        labels = labels.astype(jnp.int32)
+        logz = jax.nn.logsumexp(logits, axis=-1)            # (n, s)
+        ll = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+        return jnp.mean(logz - ll, axis=-1)                 # (n,)
+    labels = labels.reshape(labels.shape[0]).astype(jnp.int32)
     logz = jax.nn.logsumexp(logits, axis=-1)
     ll = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
     return logz - ll

@@ -87,6 +87,15 @@ def compute_batch_metrics(preds: jax.Array, labels: jax.Array,
     else:
         mask = (jnp.arange(bs) < nvalid).astype(jnp.float32)
         count = jnp.asarray(nvalid, jnp.int32)
+    if preds.ndim == 3 and labels.ndim == 2:
+        # sequence model (n, s, vocab) + token labels (n, s): fold tokens
+        # into the sample dim so every metric is per-token
+        s = preds.shape[1]
+        preds = preds.reshape(bs * s, preds.shape[-1])
+        labels = labels.reshape(bs * s, 1)
+        mask = jnp.repeat(mask, s)
+        count = count * s
+        bs = bs * s
     out: Dict[str, jax.Array] = {"count": count}
     pf = preds.astype(jnp.float32)
     for m in metric_names:

@@ -29,7 +29,7 @@ import numpy as np
 
 from . import losses as losses_mod
 from . import metrics as metrics_mod
-from .config import DeviceType, FFConfig, ParallelConfig
+from .config import DeviceType, FFConfig, MemoryType, ParallelConfig
 from .initializers import GlorotUniform
 from .op import Op, OpContext, OpType
 from .optimizers import Optimizer, SGDOptimizer
@@ -47,7 +47,11 @@ from .tensor import Parameter, Tensor
 class FFModel:
     def __init__(self, config: Optional[FFConfig] = None,
                  mesh: Optional[MachineMesh] = None):
-        self.config = config or FFConfig()
+        if config is None:
+            # the flexflow-tpu runner installs a parsed default (cli.py)
+            import flexflow_tpu
+            config = flexflow_tpu.get_default_config()
+        self.config = config
         self.layers: List[Op] = []
         self.parameters: List[Parameter] = []
         self.input_tensors: List[Tensor] = []
@@ -123,6 +127,17 @@ class FFModel:
         op = Embedding(self._uname("embedding", name), input_tensor,
                        num_entries, out_dim, aggr, kernel_initializer)
         return self._register(op).outputs[0]
+
+    def lstm(self, input_tensor, hidden_size, initial_state=None,
+             forget_bias=1.0, kernel_initializer=None, name=None):
+        """Single-layer LSTM (reference nmt/lstm.cu cuDNN fused RNN).
+        Returns ``(seq, h_n, c_n)`` tensors; pass ``initial_state=(h, c)``
+        to chain encoder → decoder (nmt/rnn.h:27-158 SharedVariable graph)."""
+        from .ops.rnn import LSTM
+        op = LSTM(self._uname("lstm", name), input_tensor, hidden_size,
+                  initial_state, forget_bias, kernel_initializer)
+        self._register(op)
+        return op.outputs[0], op.outputs[1], op.outputs[2]
 
     def multihead_attention(self, query, key=None, value=None, embed_dim=None,
                             num_heads=8, kdim=0, vdim=0, dropout=0.0,
@@ -235,12 +250,19 @@ class FFModel:
 
     def mse_loss(self, logits: Tensor, labels_shape=None, reduction="average",
                  name=None) -> Tensor:
-        """Op-form MSE loss used by DLRM (reference src/ops/mse_loss.cu:21-34).
-        Registers the model's loss type; returns the prediction tensor."""
+        """Op-form MSE loss used by DLRM (reference src/ops/mse_loss.cu:21-34):
+        registers a real MSELoss op (identity pass-through whose metric sums
+        ride the fused step — the reference's per-op PerfMetrics future) and
+        sets the model's loss type."""
+        from .ops.loss_ops import MSELoss
+        op = MSELoss(self._uname("mse_loss", name), logits, reduction)
+        self._register(op)
         self.loss_type = (losses_mod.MEAN_SQUARED_ERROR_AVG_REDUCE
                           if reduction == "average"
                           else losses_mod.MEAN_SQUARED_ERROR_SUM_REDUCE)
-        return logits
+        if losses_mod.MEAN_SQUARED_ERROR not in self.metrics:
+            self.metrics.append(losses_mod.MEAN_SQUARED_ERROR)
+        return op.outputs[0]
 
     # ------------------------------------------------------------------
     # compile
@@ -285,8 +307,22 @@ class FFModel:
         elif cfg.search_budget > 0:
             from .search.mcmc import optimize_strategies
             cfg.strategies.update(optimize_strategies(self, cfg))
+        noncanonical = []
         for op in self.layers:
-            op.parallel_config = cfg.strategies.get(op.name)
+            pc = cfg.strategies.get(op.name)
+            op.parallel_config = pc
+            if pc is not None and tuple(pc.device_ids) != tuple(
+                    range(pc.num_parts)):
+                noncanonical.append(op.name)
+        if noncanonical:
+            # reference strategies may pin parts to arbitrary processors
+            # (mapper.cc:86-103); one SPMD program cannot pin individual ops
+            # to chips, so parts map to mesh-linearized coordinates instead.
+            import warnings
+            warnings.warn(
+                f"explicit device_ids on {noncanonical} are honored as "
+                f"mesh-linearized placement only — GSPMD owns physical "
+                f"placement on TPU; use mesh_shape to steer the topology")
 
         # --- mesh construction ---
         if mesh is not None:
@@ -306,13 +342,48 @@ class FFModel:
         if self.label_tensor is None:
             n = self._final_tensor.shape[0]
             if self.loss_type == losses_mod.SPARSE_CATEGORICAL_CROSSENTROPY:
-                self.label_tensor = Tensor((n, 1), "int32", "label")
+                if self._final_tensor.num_dims == 3:
+                    # per-token labels for sequence models (NMT)
+                    self.label_tensor = Tensor(
+                        (n, self._final_tensor.shape[1]), "int32", "label")
+                else:
+                    self.label_tensor = Tensor((n, 1), "int32", "label")
             else:
                 self.label_tensor = Tensor(self._final_tensor.shape,
                                            "float32", "label")
 
+        self._resolve_host_placements()
         self._build_step_fns()
         self._compiled = True
+
+    def _resolve_host_placements(self) -> None:
+        """Host-placed parameters (reference hetero strategies: device_type
+        CPU / memory ZCM) get a pinned_host sharding; the paired device
+        sharding is used to unify memory spaces around the optimizer
+        update."""
+        from .ops.linear import host_placed
+        self._host_shardings: Dict[str, Any] = {}
+        self._dev_shardings: Dict[str, Any] = {}
+        for op in self.layers:
+            if not host_placed(op.parallel_config):
+                continue
+            for p in op.weights:
+                if self.mesh is not None:
+                    from .parallel.sharding import param_spec as pspec
+                    dev = self.mesh.sharding(
+                        pspec(p, op.parallel_config, self.mesh))
+                else:
+                    dev = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+                try:
+                    self._host_shardings[p.name] = dev.with_memory_kind(
+                        "pinned_host")
+                    self._dev_shardings[p.name] = dev
+                except Exception:
+                    import warnings
+                    warnings.warn(
+                        f"{p.name}: host placement requested but this "
+                        f"backend has no pinned_host memory; keeping device "
+                        f"placement")
 
     def _infer_mesh_shape(self) -> Dict[str, int]:
         """Derive mesh axis sizes from resolved per-op strategies: each
@@ -323,7 +394,10 @@ class FFModel:
         import math
 
         from .parallel.mesh import dim_axis_names
-        ndev = len(jax.devices())
+        # -ll:tpu / --nodes bound the worker count (reference FFConfig)
+        ndev = (self.config.num_devices if self.config.workers_per_node
+                else len(jax.devices()))
+        ndev = min(ndev, len(jax.devices()))
         lcm = {"n": 1, "c": 1, "h": 1, "w": 1, "s": 1}
         mx = dict(lcm)
         any_cfg = False
@@ -415,8 +489,25 @@ class FFModel:
                       if k not in trainable_names}
             (loss, (updates, logits, sums)), grads = grad_fn(
                 trainable, frozen, batch, rng)
+            host_sh = self._host_shardings
+            if host_sh:
+                # unify memory spaces for the elementwise update: host params
+                # visit HBM for the step, then re-pin to pinned_host (the
+                # reference's ZC-memory weights likewise stream through the
+                # GPU for the SGD task, optimizer_kernel.cu)
+                dev_sh = self._dev_shardings
+                trainable = {k: (jax.device_put(v, dev_sh[k])
+                                 if k in host_sh else v)
+                             for k, v in trainable.items()}
+                grads = {k: (jax.device_put(g, dev_sh[k])
+                             if k in host_sh else g)
+                         for k, g in grads.items()}
             new_trainable, new_opt_state = self.optimizer.update(
                 trainable, grads, opt_state)
+            # NOTE: updated host params leave the step in device memory; the
+            # eager _repin_host() in train_batch/fit moves them back to
+            # pinned_host (XLA's SPMD pass cannot yet shard an in-program
+            # host-placement annotation on the output side)
             new_params = {**frozen, **updates, **new_trainable}
             return new_params, new_opt_state, loss, sums
 
@@ -463,8 +554,9 @@ class FFModel:
             init = p.initializer or GlorotUniform()
             val = init(sub, p.shape, jnp.dtype(self.config.param_dtype)
                        if p.dtype == "float32" else jnp.dtype(p.dtype))
-            if self.mesh is not None and self.mesh.is_distributed:
-                op = p.owner_op
+            if p.name in getattr(self, "_host_shardings", {}):
+                val = jax.device_put(val, self._host_shardings[p.name])
+            elif self.mesh is not None and self.mesh.is_distributed:
                 pc = None
                 for lop in self.layers:
                     if p in lop.weights:
@@ -474,9 +566,15 @@ class FFModel:
                 val = jax.device_put(val, self.mesh.sharding(spec))
             params[p.name] = val
         self._params = params
-        self._opt_state = self.optimizer.init_state(
-            {k: v for k, v in params.items()
-             if k in self._split_params()})
+        trainable = {}
+        for k, v in params.items():
+            if k not in self._split_params():
+                continue
+            if k in getattr(self, "_host_shardings", {}):
+                # optimizer slots stay in device memory even for host params
+                v = jax.device_put(v, self._dev_shardings[k])
+            trainable[k] = v
+        self._opt_state = self.optimizer.init_state(trainable)
         self._step = 0
 
     def get_parameter_by_name(self, name: str) -> Optional[Parameter]:
@@ -567,11 +665,19 @@ class FFModel:
     # ------------------------------------------------------------------
     # fit / evaluate / predict (fused fast path)
     # ------------------------------------------------------------------
+    def _repin_host(self) -> None:
+        """Move host-placed params back to pinned_host after a step (async
+        eager transfer; see note in train_step)."""
+        for k, sh in self._host_shardings.items():
+            self._params[k] = jax.device_put(self._params[k], sh)
+
     def train_batch(self, *arrays) -> float:
         """One fused train step; returns loss."""
         batch = tuple(self._shard_batch(arrays))
         self._params, self._opt_state, loss, sums = self._train_step(
             self._params, self._opt_state, batch, self._step)
+        if self._host_shardings:
+            self._repin_host()
         self._step += 1
         self._last_metric_sums = sums
         return loss
@@ -590,16 +696,25 @@ class FFModel:
         for cb in callbacks:
             cb.set_model(self)
             cb.on_train_begin()
+        if cfg.profiling:
+            # --profiling: per-op fwd/bwd latency table (reference
+            # conv_2d.cu:446-471 cudaEvent prints), measured in isolation
+            from .profiling import profile_model
+            profile_model(self)
         from .data.dataloader import PrefetchLoader
         loader = PrefetchLoader(self, xs, y, batch_size=bs)
         t_start = time.time()
         total_samples = 0
         for epoch in range(epochs):
+            for cb in callbacks:
+                cb.on_epoch_begin(epoch)
             self.perf_metrics = metrics_mod.PerfMetrics()
             epoch_sums = []
             for batch in loader:
                 self._params, self._opt_state, loss, sums = self._train_step(
                     self._params, self._opt_state, batch, self._step)
+                if self._host_shardings:
+                    self._repin_host()
                 self._step += 1
                 total_samples += bs
                 # keep metric sums on device; fetching here would fence the
@@ -612,6 +727,8 @@ class FFModel:
                       f"{self.perf_metrics.report(self.metrics or [self.loss_type])}")
             for cb in callbacks:
                 cb.on_epoch_end(epoch, self.perf_metrics)
+            if any(getattr(cb, "stop_training", False) for cb in callbacks):
+                break
         jax.block_until_ready(self._params)
         elapsed = time.time() - t_start
         if verbose and elapsed > 0:

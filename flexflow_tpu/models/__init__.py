@@ -1,4 +1,6 @@
 from .alexnet import build_alexnet
+from .dlrm import build_dlrm
 from .inception import build_inception_v3
 from .resnet import build_resnet50
+from .nmt import build_nmt
 from .transformer import build_transformer

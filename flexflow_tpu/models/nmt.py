@@ -1,0 +1,52 @@
+"""NMT seq2seq workload (reference ``nmt/nmt.cc:31-84``).
+
+The reference's standalone RNN engine builds a 2-layer LSTM encoder-decoder
+with embed 2048, hidden 2048, vocab 20k (nmt.cc:34-44), per-timestep ops
+spread over GPUs by a hand-built GlobalConfig.  TPU-native: the same graph is
+ordinary FFModel ops — Embedding (sequence mode) → stacked LSTM encoder →
+stacked LSTM decoder seeded with the encoder's final (h, c) per layer
+(teacher forcing on the target tokens) → vocab projection → per-token
+softmax-CE.  Parallelism comes from the standard mesh axes instead of
+per-timestep GPU pinning: DP over ``n``, TP over the gate/hidden and vocab
+dims (``c``), and the hoisted input projections shard over ``s``.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from ..config import FFConfig
+from ..model import FFModel
+from ..tensor import Tensor
+
+
+def build_nmt(config: FFConfig, vocab_size: int = 20000,
+              embed_dim: int = 2048, hidden_dim: int = 2048,
+              num_layers: int = 2, src_len: int = 24, tgt_len: int = 24
+              ) -> Tuple[FFModel, Tuple[Tensor, Tensor], Tensor]:
+    """Returns (model, (src_tokens, tgt_tokens), logits).  Labels are the
+    (n, tgt_len) next-token ids (teacher forcing)."""
+    ff = FFModel(config)
+    n = config.batch_size
+    src = ff.create_tensor((n, src_len), dtype="int32", name="src_tokens")
+    tgt = ff.create_tensor((n, tgt_len), dtype="int32", name="tgt_tokens")
+    # shared-vocab embeddings (reference uses one embed per side; keep two
+    # tables like nmt.cc's embed[2])
+    enc = ff.embedding(src, vocab_size, embed_dim, aggr="none",
+                       name="src_embedding")
+    dec = ff.embedding(tgt, vocab_size, embed_dim, aggr="none",
+                       name="tgt_embedding")
+    # encoder stack; keep each layer's final state for the decoder
+    states = []
+    t = enc
+    for i in range(num_layers):
+        t, h, c = ff.lstm(t, hidden_dim, name=f"encoder_lstm_{i}")
+        states.append((h, c))
+    # decoder stack seeded per-layer from the encoder finals (nmt.cc:34-44)
+    t = dec
+    for i in range(num_layers):
+        t, _, _ = ff.lstm(t, hidden_dim, initial_state=states[i],
+                          name=f"decoder_lstm_{i}")
+    logits = ff.dense(t, vocab_size, name="vocab_projection")
+    ff.softmax(logits)
+    return ff, (src, tgt), logits

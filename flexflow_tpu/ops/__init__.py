@@ -3,5 +3,6 @@ from .conv import Conv2D, Pool2D
 from .elementwise import ElementBinary, ElementUnary
 from .linear import Embedding, Linear
 from .norm import BatchNorm, LayerNorm, RMSNorm
+from .rnn import LSTM
 from .tensor_ops import (Concat, Dropout, Flat, Reshape, Softmax, Split,
                          Transpose)

@@ -17,10 +17,39 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
 
+from ..config import DeviceType, MemoryType
 from ..initializers import GlorotUniform, ZeroInitializer
 from ..op import Op, OpContext, OpType
 from .common import apply_activation, cast_compute
+
+
+def host_placed(pc) -> bool:
+    """True when a ParallelConfig asks for host placement (reference
+    hetero strategies: device_type CPU / memory ZCM, strategy.proto:11-18,
+    dlrm_strategy_hetero.cc)."""
+    return pc is not None and (pc.device_type == DeviceType.HOST
+                               or MemoryType.ZCM in tuple(pc.memory_types))
+
+
+def _host_gather(table, idx, mesh):
+    """Gather on the HOST for a host-resident table: only the looked-up rows
+    cross to HBM, never the table (the reference's CPU embedding task +
+    zero-copy read path, embedding.cc:18-75, mapper.cc:66-71)."""
+    from jax.experimental.compute_on import compute_on
+
+    hs = NamedSharding(mesh.mesh, PartitionSpec()).with_memory_kind(
+        "pinned_host")
+    ds = NamedSharding(mesh.mesh, PartitionSpec())
+
+    @compute_on("device_host")
+    @jax.jit
+    def gather(t, i):
+        return t.at[i].get(mode="promise_in_bounds")
+
+    y = gather(table, jax.device_put(idx, hs))
+    return jax.device_put(y, ds)
 
 
 class Linear(Op):
@@ -87,7 +116,10 @@ class Embedding(Op):
     def forward(self, params, inputs, ctx: OpContext):
         idx = inputs[0].astype(jnp.int32)
         table = params[self.w_table.name]
-        y = jnp.take(table, idx, axis=0)  # (n, [s,] d)
+        if host_placed(self.parallel_config) and ctx.mesh is not None:
+            y = _host_gather(table, idx, ctx.mesh)
+        else:
+            y = jnp.take(table, idx, axis=0)  # (n, [s,] d)
         if y.ndim == 3 and self.aggr != "none":  # bag of indices per sample
             if self.aggr == "sum":
                 y = y.sum(axis=1)

@@ -1,0 +1,37 @@
+"""Op-form MSELoss (reference ``src/ops/mse_loss.cu``, builder
+``FFModel::mse_loss`` mse_loss.cu:21-34) — the legacy loss-as-an-operator
+path DLRM uses (dlrm.cc:66).
+
+The reference op computes the per-batch MSE on-GPU and returns it as a
+``PerfMetrics`` Legion future per iteration.  TPU-native: the op is an
+identity pass-through in the forward graph (predictions flow on), while
+registering itself as the model's loss so the fused train step computes the
+scalar MSE + metric sums in the same XLA program — the PerfMetrics future
+becomes the step's on-device metric-sum output, folded host-side exactly
+like the newer Loss/Metrics path (metrics.py).
+"""
+
+from __future__ import annotations
+
+from ..op import Op, OpContext, OpType
+
+
+class MSELoss(Op):
+    op_type = OpType.MSELOSS
+
+    def __init__(self, name, logits, reduction="average"):
+        super().__init__(name, [logits])
+        assert reduction in ("average", "sum"), reduction
+        self.reduction = reduction
+        self._add_output(logits.shape, logits.dtype)
+
+    def forward(self, params, inputs, ctx: OpContext):
+        return [inputs[0]]
+
+    def parallel_dims(self):
+        # sample-parallel only (reference mse_loss.cu 2-D sample partition)
+        nd = self.outputs[0].num_dims
+        return (True,) + (False,) * (nd - 1)
+
+    def flops(self):
+        return 3 * self.outputs[0].volume

@@ -1,0 +1,101 @@
+"""LSTM — the NMT RNN engine's core op (reference ``nmt/lstm.cu:323-503``,
+cuDNN fused RNN ``cudnnRNNForwardTraining``/``BackwardData``/``BackwardWeights``).
+
+TPU-native design: cuDNN's fused RNN has no XLA twin, so the cell is built
+from primitives the MXU likes —
+
+* the input projection ``x @ Wx`` for ALL timesteps is hoisted out of the
+  recurrence into one large (n*s, 4H) matmul (sequence-parallel, shardable
+  over the ``s`` axis);
+* only the recurrent ``h @ Wh`` matmul + elementwise gate math live inside
+  a ``lax.scan`` over time, with cell state carried in float32;
+* gate order is i,f,g,o (cuDNN convention); a +1.0 forget-gate bias is the
+  standard stability default.
+
+Weight sharing across timesteps (the reference's ``SharedVariable``,
+nmt/rnn.h:27-158) is automatic: one parameter read by every scan step, and
+its gradient is the sum over timesteps — the two-phase hierarchical replica
+reduction (nmt/rnn.cu:650-706) collapses into the scan-transpose plus GSPMD's
+psum.  The reference's timestep *chunking* across GPUs
+(LSTM_PER_NODE_LENGTH=10, nmt/rnn.h:23) was a latency pipeline for
+single-GPU-memory limits; on TPU the whole recurrence stays on-chip and
+scaling comes from DP over ``n`` and TP over the gate/hidden dim (``c``),
+while the hoisted input projection shards over ``s``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..initializers import GlorotUniform, ZeroInitializer
+from ..op import Op, OpContext, OpType
+from .common import cast_compute
+
+
+class LSTM(Op):
+    """Single-layer LSTM.  Outputs ``[seq (n,s,H), h_n (n,H), c_n (n,H)]``;
+    pass ``initial_state=(h0, c0)`` tensors to chain encoder → decoder."""
+
+    op_type = OpType.LSTM
+
+    def __init__(self, name, input_tensor, hidden_size, initial_state=None,
+                 forget_bias=1.0, kernel_initializer=None):
+        inputs = [input_tensor]
+        if initial_state is not None:
+            inputs += [initial_state[0], initial_state[1]]
+        super().__init__(name, inputs)
+        n, s, d = input_tensor.shape
+        self.hidden_size = int(hidden_size)
+        self.forget_bias = float(forget_bias)
+        self._has_state = initial_state is not None
+        h = self.hidden_size
+        self._add_output((n, s, h), input_tensor.dtype, idx=0)
+        self._add_output((n, h), input_tensor.dtype, idx=1)
+        self._add_output((n, h), input_tensor.dtype, idx=2)
+        init = kernel_initializer or GlorotUniform()
+        # (out, in) layout matching Linear; 4H out = i,f,g,o gate blocks
+        self.w_x = self._add_weight((4 * h, d), init, "wx", sharded_dim=0)
+        self.w_h = self._add_weight((4 * h, h), init, "wh", sharded_dim=0)
+        self.w_b = self._add_weight((4 * h,), ZeroInitializer(), "bias")
+
+    def forward(self, params, inputs, ctx: OpContext):
+        x = cast_compute(inputs[0], ctx)                      # (n,s,d)
+        n, s, _ = x.shape
+        h_sz = self.hidden_size
+        wx = cast_compute(params[self.w_x.name], ctx)
+        wh = params[self.w_h.name].astype(jnp.float32)
+        b = params[self.w_b.name].astype(jnp.float32)
+        # hoisted input projection: one big MXU matmul over all timesteps
+        xg = jnp.einsum("nsd,gd->nsg", x, wx,
+                        preferred_element_type=jnp.float32)   # (n,s,4H)
+        if self._has_state:
+            h0 = inputs[1].astype(jnp.float32)
+            c0 = inputs[2].astype(jnp.float32)
+        else:
+            h0 = jnp.zeros((n, h_sz), jnp.float32)
+            c0 = jnp.zeros((n, h_sz), jnp.float32)
+
+        def step(carry, xg_t):
+            h, c = carry
+            gates = xg_t + h @ wh.T + b                       # (n,4H)
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            c = (jax.nn.sigmoid(f + self.forget_bias) * c
+                 + jax.nn.sigmoid(i) * jnp.tanh(g))
+            h = jax.nn.sigmoid(o) * jnp.tanh(c)
+            return (h, c), h
+
+        (h_n, c_n), hs = jax.lax.scan(step, (h0, c0),
+                                      jnp.transpose(xg, (1, 0, 2)))
+        seq = cast_compute(jnp.transpose(hs, (1, 0, 2)), ctx)
+        return [seq, cast_compute(h_n, ctx), cast_compute(c_n, ctx)]
+
+    def parallel_dims(self):
+        # (n, s, c): DP over samples, TP over the hidden/gate dim; the
+        # recurrence is serial in s so the sequence dim never splits
+        return (True, False, True)
+
+    def flops(self):
+        n, s, h = self.outputs[0].shape
+        d = self.inputs[0].shape[-1]
+        return 2 * n * s * 4 * h * (d + h)

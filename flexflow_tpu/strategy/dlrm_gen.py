@@ -1,0 +1,114 @@
+"""Offline DLRM strategy generators (reference
+``src/runtime/dlrm_strategy.cc:1-213`` and ``dlrm_strategy_hetero.cc:1-118``,
+built there as standalone executables; here a module + console entry).
+
+Two generators, emitting the same wire format the reference tools write:
+
+* :func:`generate_dlrm_strategy` — the homogeneous generator: each
+  ``embedding{i}`` table pinned to chip ``i % num_chips`` (model-parallel
+  table placement, dlrm_strategy.cc:184-189), concat per node, dense layers
+  and mse_loss data-parallel over all chips;
+* :func:`generate_dlrm_hetero_strategy` — the hetero generator: tables
+  placed on the HOST (device_type CPU + ZCM memory, the reference's
+  CPU-embedding path) with everything else data-parallel on chips.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from ..config import DeviceType, MemoryType, ParallelConfig
+from .proto import save_strategy_file
+
+FBM = MemoryType.FBM
+ZCM = MemoryType.ZCM
+
+
+def generate_dlrm_strategy(gpus_per_node: int, num_nodes: int,
+                           num_embeddings: int = 24,
+                           num_mlp_layers: int = 6
+                           ) -> Dict[str, ParallelConfig]:
+    n = gpus_per_node * num_nodes
+    out: Dict[str, ParallelConfig] = {}
+    for i in range(num_embeddings):
+        out[f"embedding{i}"] = ParallelConfig(
+            device_type=DeviceType.DEVICE, dims=(1, 1),
+            device_ids=(i % n,), memory_types=(FBM, FBM, FBM))
+    out["concat"] = ParallelConfig(
+        device_type=DeviceType.DEVICE, dims=(num_nodes, 1),
+        device_ids=tuple(i * gpus_per_node for i in range(num_nodes)),
+        memory_types=(FBM,))
+    dp = ParallelConfig(device_type=DeviceType.DEVICE, dims=(n, 1),
+                        device_ids=tuple(range(n)),
+                        memory_types=(FBM, FBM, FBM))
+    # per-layer names used by models/dlrm.py (the reference generator's
+    # single "linear" entry relies on its shared-name fallback)
+    out["linear"] = dp
+    for prefix, count in (("bot", num_mlp_layers), ("top", num_mlp_layers)):
+        for i in range(count):
+            out[f"{prefix}_dense_{i}"] = dp
+    out["mse_loss"] = ParallelConfig(
+        device_type=DeviceType.DEVICE, dims=(n, 1),
+        device_ids=tuple(range(n)), memory_types=(FBM,))
+    out["interact"] = out["concat"]
+    return out
+
+
+def generate_dlrm_hetero_strategy(gpus: int = 1, cpus: int = 1,
+                                  num_embeddings: int = 8,
+                                  num_mlp_layers: int = 6
+                                  ) -> Dict[str, ParallelConfig]:
+    out: Dict[str, ParallelConfig] = {}
+    for i in range(num_embeddings):
+        out[f"embedding{i}"] = ParallelConfig(
+            device_type=DeviceType.HOST, dims=(1, 1),
+            device_ids=(i % cpus,), memory_types=(ZCM, ZCM, ZCM))
+    dp = ParallelConfig(device_type=DeviceType.DEVICE, dims=(gpus, 1),
+                        device_ids=tuple(range(gpus)))
+    out["linear"] = dp
+    for prefix, count in (("bot", num_mlp_layers), ("top", num_mlp_layers)):
+        for i in range(count):
+            out[f"{prefix}_dense_{i}"] = dp
+    out["mse_loss"] = dp
+    out["concat"] = dp
+    out["interact"] = dp
+    return out
+
+
+def main(argv=None) -> None:
+    """Console entry (``flexflow-tpu-dlrm-strategy``): mirrors the reference
+    executables' --gpu/--node flags and output naming."""
+    import sys
+
+    argv = sys.argv[1:] if argv is None else argv
+    gpus_per_node, num_nodes, hetero, cpus, nemb = 1, 1, False, 1, 24
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--gpu":
+            i += 1
+            gpus_per_node = int(argv[i])
+        elif a == "--node":
+            i += 1
+            num_nodes = int(argv[i])
+        elif a == "--cpu":
+            i += 1
+            cpus = int(argv[i])
+        elif a == "--emb":
+            i += 1
+            nemb = int(argv[i])
+        elif a == "--hetero":
+            hetero = True
+        i += 1
+    if hetero:
+        s = generate_dlrm_hetero_strategy(gpus_per_node, cpus, nemb)
+        path = f"dlrm_strategy_{nemb}nEmb_{cpus}cpu_{gpus_per_node}gpu.pb"
+    else:
+        s = generate_dlrm_strategy(gpus_per_node, num_nodes, nemb)
+        path = f"dlrm_strategy_gpu_{gpus_per_node}_node_{num_nodes}.pb"
+    save_strategy_file(path, s)
+    print(f"wrote {path} ({len(s)} ops)")
+
+
+if __name__ == "__main__":
+    main()

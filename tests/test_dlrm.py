@@ -1,0 +1,121 @@
+"""DLRM tests (reference examples/cpp/DLRM — VERDICT next-round #5):
+op-form mse_loss, multi-table embeddings + interact_features, embedding-table
+TP, host placement, and the offline strategy generators."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.config import DeviceType, MemoryType, ParallelConfig
+from flexflow_tpu.models.dlrm import build_dlrm
+from flexflow_tpu.parallel.mesh import MachineMesh
+
+EMB = (100, 200, 50, 80)
+
+
+def _build(mesh_shape, strategies=None, batch=16):
+    cfg = ff.FFConfig(batch_size=batch, compute_dtype="float32")
+    if strategies:
+        cfg.strategies = strategies
+    model, inputs, preds = build_dlrm(
+        cfg, embedding_size=EMB, sparse_feature_size=8,
+        mlp_bot=(4, 16, 8), mlp_top=(40, 16, 1))
+    model.compile(ff.SGDOptimizer(lr=0.05), metrics=[],
+                  final_tensor=preds, mesh=MachineMesh(mesh_shape))
+    model.init_layers(seed=0)
+    return model
+
+
+def _data(batch=16, seed=0):
+    rng = np.random.default_rng(seed)
+    sparse = [rng.integers(0, v, (batch, 1)).astype(np.int32) for v in EMB]
+    dense = rng.standard_normal((batch, 4)).astype(np.float32)
+    y = rng.random((batch, 1)).astype(np.float32)
+    return sparse + [dense], y
+
+
+def _train(mesh_shape, strategies=None, steps=5):
+    model = _build(mesh_shape, strategies)
+    xs, y = _data()
+    return model, [float(model.train_batch(*xs, y)) for _ in range(steps)]
+
+
+def test_dlrm_trains_and_reports_mse_metric():
+    model, losses = _train({"n": 1})
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    # op-form mse_loss auto-registers the MSE metric (the reference op
+    # returns a PerfMetrics future per iteration, mse_loss.cu:21-34)
+    assert "mean_squared_error" in model.metrics
+    assert model.loss_type == "mean_squared_error_avg_reduce"
+
+
+def test_dlrm_dp_parity():
+    _, base = _train({"n": 1})
+    _, dp = _train({"n": 8})
+    np.testing.assert_allclose(base, dp, rtol=2e-4, atol=2e-5)
+
+
+def test_dlrm_embedding_table_tp_parity():
+    """Tables shard over their out-dim on 'c' (reference
+    embedding.cu:95-103) — VERDICT weak #10 made this reachable."""
+    _, base = _train({"n": 1})
+    tp = {f"embedding{i}": ParallelConfig(dims=(1, 4),
+                                          device_ids=tuple(range(4)))
+          for i in range(4)}
+    _, dptp = _train({"n": 2, "c": 4}, tp)
+    np.testing.assert_allclose(base, dptp, rtol=2e-4, atol=2e-5)
+
+
+def test_dlrm_host_placed_tables():
+    """device_type HOST tables live in pinned_host memory and still train
+    (reference dlrm_strategy_hetero.cc CPU embeddings)."""
+    host = {f"embedding{i}": ParallelConfig(
+        device_type=DeviceType.HOST, dims=(1, 1), device_ids=(0,),
+        memory_types=(MemoryType.ZCM,) * 3) for i in range(4)}
+    model, losses = _train({"n": 2}, host)
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    for i in range(4):
+        p = model._params[f"embedding{i}/table"]
+        assert p.sharding.memory_kind == "pinned_host", p.sharding
+    # numerics match the all-device run
+    _, base = _train({"n": 2})
+    np.testing.assert_allclose(base, losses, rtol=2e-4, atol=2e-5)
+
+
+def test_dlrm_strategy_generator_roundtrip(tmp_path):
+    from flexflow_tpu.strategy.dlrm_gen import (generate_dlrm_strategy,
+                                                generate_dlrm_hetero_strategy)
+    from flexflow_tpu.strategy.proto import (load_strategy_file,
+                                             save_strategy_file)
+
+    s = generate_dlrm_strategy(gpus_per_node=4, num_nodes=2,
+                               num_embeddings=4, num_mlp_layers=2)
+    path = os.path.join(tmp_path, "dlrm8.pb")
+    save_strategy_file(path, s)
+    loaded = load_strategy_file(path)
+    assert loaded.keys() == s.keys()
+    assert loaded["embedding1"].device_ids == (1,)
+    assert loaded["bot_dense_0"].dims == (8, 1)
+
+    # hetero file drives real host placement through compile()
+    hs = generate_dlrm_hetero_strategy(gpus=8, cpus=1, num_embeddings=4,
+                                       num_mlp_layers=2)
+    hpath = os.path.join(tmp_path, "dlrm_hetero.pb")
+    save_strategy_file(hpath, hs)
+    cfg = ff.FFConfig(batch_size=16, compute_dtype="float32",
+                      import_strategy_file=hpath)
+    model, inputs, preds = build_dlrm(
+        cfg, embedding_size=EMB, sparse_feature_size=8,
+        mlp_bot=(4, 16, 8), mlp_top=(40, 16, 1))
+    model.compile(ff.SGDOptimizer(lr=0.05), metrics=[], final_tensor=preds,
+                  mesh=MachineMesh({"n": 8}))
+    model.init_layers(seed=0)
+    assert model._params["embedding0/table"].sharding.memory_kind == \
+        "pinned_host"
+    xs, y = _data()
+    assert np.isfinite(float(model.train_batch(*xs, y)))
