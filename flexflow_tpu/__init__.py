@@ -21,7 +21,25 @@ from .optimizers import AdamOptimizer, Optimizer, SGDOptimizer
 from .parallel.mesh import MachineMesh
 from .tensor import Parameter, Tensor
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
+
+_default_config: "FFConfig | None" = None
+
+
+def set_default_config(cfg: FFConfig) -> None:
+    """Install the process-wide default FFConfig (used by the
+    ``flexflow-tpu`` script runner, cli.py)."""
+    global _default_config
+    _default_config = cfg
+
+
+def get_default_config() -> FFConfig:
+    """A fresh copy per call — models must not share mutable strategy state
+    (compile() writes searched strategies into its config)."""
+    import copy
+    if _default_config is None:
+        return FFConfig()
+    return copy.deepcopy(_default_config)
 
 LOSS_SPARSE_CATEGORICAL_CROSSENTROPY = losses.SPARSE_CATEGORICAL_CROSSENTROPY
 LOSS_CATEGORICAL_CROSSENTROPY = losses.CATEGORICAL_CROSSENTROPY

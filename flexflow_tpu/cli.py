@@ -1,0 +1,44 @@
+"""``flexflow-tpu`` console entry — the reference's ``flexflow_python``
+runner (python/Makefile, flexflow_top.py:164-220): parses the FlexFlow flag
+set into an FFConfig, installs it as the process default, and executes the
+user script.
+
+    flexflow-tpu my_model.py -b 64 -e 10 --lr 0.01 -ll:tpu 8 --budget 500
+
+Where the reference launches the script as a Legion top-level task, here the
+script simply runs under CPython with ``FFConfig.parse_args``'s result made
+available via :func:`flexflow_tpu.get_default_config` (scripts may also call
+``FFConfig.parse_args()`` themselves, same flags)."""
+
+from __future__ import annotations
+
+import runpy
+import sys
+
+from .config import FFConfig
+
+
+def main(argv=None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    script = None
+    for a in argv:
+        if a.endswith(".py"):
+            script = a
+            break
+    if script is None:
+        print("usage: flexflow-tpu <script.py> [FlexFlow flags]\n"
+              "flags (reference model.cc:1221-1289): -e -b --lr --wd -d "
+              "--budget --alpha -s/-import -ll:tpu -ll:cpu --nodes "
+              "--profiling --seed --remat", file=sys.stderr)
+        raise SystemExit(2)
+    flags = [a for a in argv if a != script]
+    cfg = FFConfig.parse_args(flags)
+    import flexflow_tpu
+    flexflow_tpu.set_default_config(cfg)
+    # the script sees the remaining argv like any __main__
+    sys.argv = [script] + flags
+    runpy.run_path(script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+"""flexflow_tpu.keras — Keras-compatible frontend (reference
+``python/flexflow/keras``): Sequential + functional ``Model``,
+layer/optimizer/callback surfaces, and the accuracy-verification callbacks
+the reference's example suite uses as its test harness."""
+
+from . import callbacks, datasets, layers, optimizers
+from .callbacks import (Callback, EpochVerifyMetrics, LearningRateScheduler,
+                        ModelAccuracy, VerifyMetrics)
+from .layers import (Activation, Add, AveragePooling2D, BatchNormalization,
+                     Concatenate, Conv2D, Dense, Dropout, Embedding, Flatten,
+                     Input, InputLayer, LayerNormalization, MaxPooling2D,
+                     Multiply, Softmax, Subtract)
+from .models import BaseModel, Model, Sequential
+from .optimizers import SGD, Adam

@@ -1,0 +1,95 @@
+"""Keras callbacks (reference ``python/flexflow/keras/callbacks.py``),
+including the metric-verification callbacks the reference uses as its test
+harness (callbacks.py:64-82 + examples/python/keras/accuracy.py) — the
+accuracy-regression pattern SURVEY §4 identifies as the reference's test
+strategy."""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional
+
+
+class ModelAccuracy(enum.Enum):
+    """Per-model accuracy bounds (reference
+    examples/python/keras/accuracy.py)."""
+
+    MNIST_MLP = 90
+    MNIST_CNN = 90
+    REUTERS_MLP = 90
+    CIFAR10_CNN = 90
+    CIFAR10_ALEXNET = 90
+
+
+class Callback:
+    def __init__(self):
+        self.model = None
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+
+class LearningRateScheduler(Callback):
+    """reference callbacks.py:44-62: sets optimizer lr per epoch."""
+
+    def __init__(self, schedule):
+        super().__init__()
+        self.schedule = schedule
+
+    def on_epoch_begin(self, epoch, logs=None):
+        lr = self.schedule(epoch)
+        opt = self.model.optimizer
+        if not hasattr(opt, "lr"):
+            raise ValueError('Optimizer must have a "lr" attribute.')
+        opt.lr = float(lr)
+        # the jitted step closes over the optimizer object; re-trace with
+        # the new hyperparameter
+        self.model._build_step_fns()
+        print("set learning rate ", opt.lr)
+
+
+class VerifyMetrics(Callback):
+    """Asserts the final training accuracy beats the per-model bound
+    (reference callbacks.py:64-72)."""
+
+    def __init__(self, accuracy: ModelAccuracy):
+        super().__init__()
+        self.accuracy = accuracy.value
+
+    def on_train_end(self, logs=None):
+        perf = self.model.perf_metrics
+        acc = 100.0 * perf.accuracy
+        assert acc >= self.accuracy, \
+            f"Accuracy is wrong: {acc:.2f} < {self.accuracy}"
+
+
+class EpochVerifyMetrics(Callback):
+    """Per-epoch accuracy check with optional early stop
+    (reference callbacks.py:74-82)."""
+
+    def __init__(self, accuracy: ModelAccuracy, early_stop: bool = True):
+        super().__init__()
+        self.accuracy = accuracy.value
+        self.early_stop = early_stop
+        self.reached = False
+        self.stop_training = False  # fit() breaks the epoch loop on True
+
+    def on_epoch_end(self, epoch, logs=None):
+        perf = logs if logs is not None else self.model.perf_metrics
+        acc = 100.0 * perf.accuracy
+        if acc >= self.accuracy:
+            self.reached = True
+            if self.early_stop:
+                self.stop_training = True

@@ -1,0 +1,66 @@
+"""Dataset loaders (reference ``python/flexflow/keras/datasets``:
+mnist/cifar10/reuters download-and-cache loaders).
+
+This environment has no network egress, so each loader reads the standard
+cached file layout when present (``~/.keras/datasets`` or an explicit
+``path``) and otherwise falls back to a deterministic synthetic set with the
+real shapes — the reference's own examples run on synthetic data when no
+dataset is passed (README.md:44), so synthetic-by-default preserves the
+test semantics.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+
+import numpy as np
+
+_CACHE = os.path.expanduser("~/.keras/datasets")
+
+
+def _synth_images(n, shape, classes, seed):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, classes, (n,)).astype(np.int32)
+    x = rng.random((n,) + shape, dtype=np.float32) * 0.1
+    # class-dependent mean so simple models can actually fit the data
+    x += (y.astype(np.float32) / classes).reshape((n,) + (1,) * len(shape))
+    return x, y
+
+
+class mnist:
+    @staticmethod
+    def load_data(path: str = "mnist.npz", n_synth: int = 2048):
+        full = path if os.path.isabs(path) else os.path.join(_CACHE, path)
+        if os.path.exists(full):
+            with np.load(full, allow_pickle=True) as f:
+                return (f["x_train"], f["y_train"]), (f["x_test"], f["y_test"])
+        xtr, ytr = _synth_images(n_synth, (28, 28), 10, seed=0)
+        xte, yte = _synth_images(n_synth // 4, (28, 28), 10, seed=1)
+        return (np.uint8(xtr * 255), ytr), (np.uint8(xte * 255), yte)
+
+
+class cifar10:
+    @staticmethod
+    def load_data(path: str = "cifar-10-batches-py", n_synth: int = 2048):
+        """Reads the standard python-pickle CIFAR-10 batches when present
+        (the reference's binary reader is flexflow_dataloader.cc:512-599)."""
+        full = path if os.path.isabs(path) else os.path.join(_CACHE, path)
+        if os.path.isdir(full):
+            xs, ys = [], []
+            for i in range(1, 6):
+                with open(os.path.join(full, f"data_batch_{i}"), "rb") as f:
+                    d = pickle.load(f, encoding="bytes")
+                xs.append(d[b"data"].reshape(-1, 3, 32, 32))
+                ys.extend(d[b"labels"])
+            xtr = np.concatenate(xs)
+            ytr = np.asarray(ys, np.int32)
+            with open(os.path.join(full, "test_batch"), "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            xte = d[b"data"].reshape(-1, 3, 32, 32)
+            yte = np.asarray(d[b"labels"], np.int32)
+            return (xtr, ytr), (xte, yte)
+        xtr, ytr = _synth_images(n_synth, (3, 32, 32), 10, seed=0)
+        xte, yte = _synth_images(n_synth // 4, (3, 32, 32), 10, seed=1)
+        return (np.uint8(xtr * 255), ytr), (np.uint8(xte * 255), yte)

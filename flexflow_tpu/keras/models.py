@@ -1,0 +1,204 @@
+"""Keras-compatible Sequential / functional Model (reference
+``python/flexflow/keras/models/{base_model,sequential,model}.py``).
+
+``compile`` builds the core FFModel from the recorded layer graph and
+delegates to ``FFModel.compile`` (the reference's
+``_create_flexflow_layers`` + ``_ffmodel.compile``, base_model.py:129-192);
+``fit``/``evaluate``/``predict`` drive the fused training verbs with the
+reference's callback protocol (``_train``, base_model.py:194-251).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from .. import losses as core_losses
+from .. import metrics as core_metrics
+from ..config import FFConfig
+from ..model import FFModel
+from .layers import InputLayer, KerasTensor, Layer
+from .optimizers import to_core_optimizer
+
+_LOSS_MAP = {
+    "categorical_crossentropy": core_losses.CATEGORICAL_CROSSENTROPY,
+    "sparse_categorical_crossentropy":
+        core_losses.SPARSE_CATEGORICAL_CROSSENTROPY,
+    "mean_squared_error": core_losses.MEAN_SQUARED_ERROR,
+    "mse": core_losses.MEAN_SQUARED_ERROR,
+}
+
+_METRIC_MAP = {
+    "accuracy": core_metrics.ACCURACY,
+    "categorical_crossentropy": core_metrics.CATEGORICAL_CROSSENTROPY,
+    "sparse_categorical_crossentropy":
+        core_metrics.SPARSE_CATEGORICAL_CROSSENTROPY,
+    "mean_squared_error": core_metrics.MEAN_SQUARED_ERROR,
+    "root_mean_squared_error": core_metrics.ROOT_MEAN_SQUARED_ERROR,
+    "mean_absolute_error": core_metrics.MEAN_ABSOLUTE_ERROR,
+}
+
+
+class BaseModel:
+    def __init__(self, name: Optional[str] = None):
+        self.name = name
+        self.ffmodel: Optional[FFModel] = None
+        self.ffconfig: Optional[FFConfig] = None
+        self._compiled = False
+
+    # ---- graph -> FFModel ----------------------------------------------
+    def _topo_layers(self, outputs: List[KerasTensor]) -> List[Layer]:
+        seen: List[Layer] = []
+
+        def visit(t: KerasTensor):
+            layer = t.producer
+            if layer is None or layer in seen:
+                return
+            if not isinstance(layer, InputLayer) and layer.output is not t:
+                raise ValueError(
+                    f"layer {layer.name!r} was called more than once; "
+                    f"shared-layer reuse is not supported — instantiate a "
+                    f"separate layer per call")
+            for src in layer.inbound:
+                visit(src)
+            seen.append(layer)
+
+        for t in outputs:
+            visit(t)
+        return seen
+
+    def _build_ff(self, inputs: List[KerasTensor],
+                  outputs: List[KerasTensor], config: FFConfig) -> None:
+        ff = FFModel(config)
+        values: Dict[int, object] = {}
+        for kt in inputs:
+            layer = kt.producer
+            assert isinstance(layer, InputLayer), \
+                "functional graphs must start at Input()"
+            values[id(kt)] = ff.create_tensor(
+                (config.batch_size,) + kt.shape, dtype=kt.dtype,
+                name=layer.name)
+        for layer in self._topo_layers(outputs):
+            if isinstance(layer, InputLayer):
+                continue
+            in_ts = [values[id(t)] for t in layer.inbound]
+            out = layer.build_ff(ff, in_ts)
+            values[id(layer.output)] = out
+            layer._core_model = ff
+        self.ffmodel = ff
+        self._ff_outputs = [values[id(t)] for t in outputs]
+
+    # ---- keras API ------------------------------------------------------
+    def compile(self, optimizer, loss=None, metrics=None, config=None,
+                mesh=None, **kwargs):
+        for k in ("loss_weights", "weighted_metrics", "run_eagerly"):
+            assert kwargs.pop(k, None) is None, f"{k} is not supported"
+        assert loss is not None, "loss is None"
+        loss_type = _LOSS_MAP.get(loss, loss) if isinstance(loss, str) else loss
+        metric_types = []
+        for m in metrics or []:
+            assert isinstance(m, str) and m in _METRIC_MAP, \
+                f"unsupported metric {m!r}"
+            metric_types.append(_METRIC_MAP[m])
+        if config is None:
+            # pick up the flexflow-tpu runner's parsed flags (cli.py)
+            import flexflow_tpu
+            config = flexflow_tpu.get_default_config()
+        self.ffconfig = config
+        self._build_graph()  # subclass hook: sets self._inputs/_outputs
+        self._build_ff(self._inputs, self._outputs, self.ffconfig)
+        core_opt = to_core_optimizer(optimizer)
+        self.optimizer = core_opt
+        # fused softmax-CE parity: compile() resolves the softmax/logit
+        # split itself (model.py)
+        self.ffmodel.compile(core_opt, loss_type, metric_types, mesh=mesh,
+                             final_tensor=self._ff_outputs[0])
+        self.ffmodel.init_layers(seed=self.ffconfig.seed)
+        self._compiled = True
+
+    def fit(self, x=None, y=None, batch_size=None, epochs=1, verbose=1,
+            callbacks=None, **kwargs):
+        for k, dflt in (("validation_split", 0.0), ("validation_data", None),
+                        ("class_weight", None), ("sample_weight", None),
+                        ("initial_epoch", 0), ("steps_per_epoch", None)):
+            assert kwargs.pop(k, dflt) == dflt, f"{k} is not supported"
+        assert self._compiled, "compile() first"
+        return self.ffmodel.fit(x, y, epochs=epochs, batch_size=batch_size,
+                                callbacks=callbacks, verbose=bool(verbose))
+
+    def evaluate(self, x, y, batch_size=None):
+        return self.ffmodel.evaluate(x, y, batch_size=batch_size)
+
+    def predict(self, x, batch_size=None):
+        return self.ffmodel.predict(x, batch_size=batch_size)
+
+    def summary(self) -> str:
+        return self.ffmodel.summary() if self.ffmodel else type(self).__name__
+
+    def get_layer(self, name=None, index=None) -> Layer:
+        layers = self._layer_list()
+        if name is not None:
+            for l in layers:
+                if l.name == name:
+                    return l
+            raise ValueError(f"no layer named {name!r}")
+        return layers[index]
+
+    @property
+    def layers(self) -> List[Layer]:
+        return [l for l in self._layer_list()
+                if not isinstance(l, InputLayer)]
+
+    def get_perf_metrics(self):
+        return self.ffmodel.perf_metrics
+
+
+class Model(BaseModel):
+    """Functional model: ``Model(inputs, outputs)``."""
+
+    def __init__(self, inputs, outputs, name=None):
+        super().__init__(name)
+        self._inputs = list(inputs) if isinstance(inputs, (list, tuple)) \
+            else [inputs]
+        self._outputs = list(outputs) if isinstance(outputs, (list, tuple)) \
+            else [outputs]
+
+    def _build_graph(self):
+        pass  # graph already recorded by layer calls
+
+    def _layer_list(self):
+        return self._topo_layers(self._outputs)
+
+
+class Sequential(BaseModel):
+    def __init__(self, layers: Optional[Sequence[Layer]] = None, name=None):
+        super().__init__(name)
+        self._stack: List[Layer] = []
+        for l in layers or []:
+            self.add(l)
+
+    def add(self, layer: Layer) -> None:
+        self._stack.append(layer)
+
+    def pop(self) -> None:
+        self._stack.pop()
+
+    def _build_graph(self):
+        first = self._stack[0]
+        if isinstance(first, InputLayer):
+            t = first.output
+            stack = self._stack[1:]
+        else:
+            assert first.input_shape is not None, \
+                "first layer needs input_shape="
+            dtype = "int32" if type(first).__name__ == "Embedding" \
+                else "float32"
+            inp = InputLayer(shape=first.input_shape, dtype=dtype)
+            t = inp.output
+            stack = self._stack
+        self._inputs = [t]
+        for layer in stack:
+            t = layer(t)
+        self._outputs = [t]
+
+    def _layer_list(self):
+        return list(self._stack)

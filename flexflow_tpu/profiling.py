@@ -1,0 +1,101 @@
+"""Per-op profiling (the ``--profiling`` flag — reference cudaEvent timing
+inside every forward/backward task, conv_2d.cu:446-471, linear.cu:379-406).
+
+XLA fuses the whole step into one program, so per-op numbers cannot be read
+off the fused execution; like the reference's simulator measure mode
+(``measure_compute_time``, simulator.cc:235-273), each op is compiled and
+timed IN ISOLATION on the real device, fwd and fwd+bwd, then reported as a
+table.  ``FFModel.fit`` prints it once up front when ``config.profiling``
+is set.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .op import Op, OpContext
+
+
+def _example_inputs(op: Op):
+    outs = []
+    for t in op.inputs:
+        if t.dtype.startswith("int"):
+            outs.append(jnp.zeros(t.shape, jnp.dtype(t.dtype)))
+        else:
+            outs.append(jnp.ones(t.shape, jnp.dtype(t.dtype)))
+    return outs
+
+
+def _init_params(op: Op, seed: int = 0) -> Dict[str, jax.Array]:
+    from .initializers import GlorotUniform
+    key = jax.random.PRNGKey(seed)
+    params = {}
+    for i, p in enumerate(op.weights):
+        init = p.initializer or GlorotUniform()
+        params[p.name] = init(jax.random.fold_in(key, i), p.shape,
+                              jnp.dtype(p.dtype))
+    return params
+
+
+def profile_op(op: Op, compute_dtype: str = "bfloat16", warmup: int = 2,
+               iters: int = 5) -> Dict[str, float]:
+    """(fwd_ms, bwd_ms) for one op, timed in isolation (reference
+    measure_compute_time contract: returns per-config latency)."""
+    ctx = OpContext(training=True, rng=jax.random.PRNGKey(0),
+                    compute_dtype=compute_dtype)
+    params = _init_params(op)
+    inputs = _example_inputs(op)
+
+    @jax.jit
+    def fwd(params, inputs):
+        return op.forward(params, inputs, ctx)[0]
+
+    float_in = [i for i, t in enumerate(op.inputs)
+                if not t.dtype.startswith("int")]
+
+    @jax.jit
+    def fwd_bwd(params, inputs):
+        def loss(params, *flt):
+            full = list(inputs)
+            for i, v in zip(float_in, flt):
+                full[i] = v
+            outs = op.forward(params, full, ctx)
+            return sum(jnp.sum(o.astype(jnp.float32) ** 2) for o in outs
+                       if jnp.issubdtype(o.dtype, jnp.floating))
+        return jax.grad(loss, argnums=0)(params,
+                                         *[inputs[i] for i in float_in])
+
+    def _time(fn, *args) -> float:
+        for _ in range(warmup):
+            jax.block_until_ready(fn(*args))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    fwd_ms = _time(fwd, params, inputs)
+    try:
+        tot_ms = _time(fwd_bwd, params, inputs) if (params or float_in) \
+            else fwd_ms
+    except Exception:
+        tot_ms = float("nan")  # non-differentiable op (e.g. int gather only)
+    return {"fwd_ms": fwd_ms, "bwd_ms": max(0.0, tot_ms - fwd_ms)}
+
+
+def profile_model(model, file=None) -> List[Dict[str, float]]:
+    """Print the reference's per-op timing table for every layer."""
+    rows = []
+    print(f"{'op':30s} {'type':14s} {'fwd(ms)':>9s} {'bwd(ms)':>9s}",
+          file=file)
+    for op in model.layers:
+        r = profile_op(op, model.config.compute_dtype)
+        rows.append({"name": op.name, **r})
+        print(f"{op.name:30s} {op.op_type.value:14s} "
+              f"{r['fwd_ms']:9.3f} {r['bwd_ms']:9.3f}", file=file)
+    return rows

@@ -1,0 +1,4 @@
+"""flexflow_tpu.torch — torch.nn-compatible frontend (reference
+``python/flexflow/torch``)."""
+
+from . import nn

@@ -1,0 +1,95 @@
+"""CLI runner, config parser, per-op profiling, and device_ids honesty
+(VERDICT next-round #9: no decorative surfaces)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.config import FFConfig, ParallelConfig
+
+
+def test_parse_args_reference_flagset():
+    cfg = FFConfig.parse_args([
+        "-e", "5", "-b", "128", "--lr", "0.1", "--wd", "0.001",
+        "-ll:tpu", "4", "--nodes", "2", "--budget", "100", "--alpha", "0.2",
+        "--profiling", "-s", "out.pb", "-import", "in.pb", "--seed", "7"])
+    assert cfg.epochs == 5 and cfg.batch_size == 128
+    assert cfg.learning_rate == 0.1 and cfg.weight_decay == 0.001
+    assert cfg.workers_per_node == 4 and cfg.num_nodes == 2
+    assert cfg.num_devices == 8
+    assert cfg.search_budget == 100 and cfg.search_alpha == 0.2
+    assert cfg.profiling and cfg.seed == 7
+    assert cfg.export_strategy_file == "out.pb"
+    assert cfg.import_strategy_file == "in.pb"
+
+
+def test_cli_runs_script_with_default_config(tmp_path):
+    """flexflow-tpu runner executes a user script with the parsed config
+    installed (reference flexflow_python contract)."""
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent("""
+        import numpy as np
+        import flexflow_tpu as ff
+
+        cfg = ff.get_default_config()
+        assert cfg.batch_size == 16, cfg.batch_size
+        assert cfg.epochs == 2, cfg.epochs
+        model = ff.FFModel()     # picks up the default config
+        x = model.create_tensor((16, 8), name="x")
+        t = model.dense(x, 16, activation="relu")
+        t = model.dense(t, 4)
+        model.compile(ff.SGDOptimizer(lr=0.1),
+                      "sparse_categorical_crossentropy", [], final_tensor=t)
+        model.init_layers(seed=0)
+        rng = np.random.default_rng(0)
+        loss = model.train_batch(
+            rng.standard_normal((16, 8)).astype(np.float32),
+            rng.integers(0, 4, (16, 1)).astype(np.int32))
+        print("CLI_OK", float(loss))
+    """))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-m", "flexflow_tpu.cli", str(script),
+         "-b", "16", "-e", "2"],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr
+    assert "CLI_OK" in out.stdout
+
+
+def test_profiling_prints_per_op_table(capsys):
+    """--profiling emits real per-op fwd/bwd timings (reference
+    conv_2d.cu:446-471), not a silent no-op."""
+    cfg = ff.FFConfig(batch_size=8, compute_dtype="float32", profiling=True,
+                      epochs=1)
+    model = ff.FFModel(cfg)
+    x = model.create_tensor((8, 3, 8, 8), name="x")
+    t = model.conv2d(x, 4, 3, 3, 1, 1, 1, 1, activation="relu")
+    t = model.flat(t)
+    t = model.dense(t, 4)
+    model.compile(ff.SGDOptimizer(lr=0.1),
+                  "sparse_categorical_crossentropy", [], final_tensor=t)
+    model.init_layers(seed=0)
+    rng = np.random.default_rng(0)
+    model.fit(rng.standard_normal((16, 3, 8, 8)).astype(np.float32),
+              rng.integers(0, 4, (16, 1)).astype(np.int32), epochs=1,
+              verbose=False)
+    out = capsys.readouterr().out
+    assert "fwd(ms)" in out and "conv2d" in out and "dense" in out
+
+
+def test_noncanonical_device_ids_warn():
+    cfg = ff.FFConfig(batch_size=8, compute_dtype="float32")
+    cfg.strategies = {"dense": ParallelConfig(dims=(1, 1), device_ids=(3,))}
+    model = ff.FFModel(cfg)
+    x = model.create_tensor((8, 4), name="x")
+    t = model.dense(x, 4)
+    with pytest.warns(UserWarning, match="device_ids"):
+        model.compile(ff.SGDOptimizer(lr=0.1),
+                      "sparse_categorical_crossentropy", [], final_tensor=t)

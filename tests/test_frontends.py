@@ -1,0 +1,200 @@
+"""Keras + torch frontend tests (reference ``python/flexflow/keras`` and
+``python/flexflow/torch`` — VERDICT next-round #8), including the
+accuracy-callback verification pattern that is the reference's own test
+strategy (SURVEY §4, keras/callbacks.py:64-82)."""
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu import keras
+from flexflow_tpu.keras import (Activation, Conv2D, Dense, Flatten, Input,
+                                MaxPooling2D, Model, ModelAccuracy,
+                                Sequential, VerifyMetrics)
+
+
+def _learnable_data(n=256, shape=(12,), classes=4, seed=0):
+    """Labels linearly decodable from inputs so tiny models hit >90%."""
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, classes, (n,)).astype(np.int32)
+    x = rng.standard_normal((n,) + shape).astype(np.float32) * 0.05
+    flat = x.reshape(n, -1)
+    flat[np.arange(n), y % flat.shape[1]] += 2.0
+    return x.reshape((n,) + shape), y.reshape(n, 1)
+
+
+def test_sequential_mlp_with_verify_metrics():
+    """seq_mnist_mlp pattern (examples/python/keras/seq_mnist_mlp.py):
+    Sequential + compile + fit with a VerifyMetrics accuracy assertion."""
+    x, y = _learnable_data()
+    cfg = ff.FFConfig(batch_size=32, compute_dtype="float32", epochs=6)
+    model = Sequential([
+        Dense(64, activation="relu", input_shape=(12,)),
+        Dense(32, activation="relu"),
+        Dense(4),
+        Activation("softmax"),
+    ])
+    model.compile(keras.SGD(learning_rate=0.2),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], config=cfg)
+    model.fit(x, y, epochs=6, verbose=0,
+              callbacks=[VerifyMetrics(ModelAccuracy.MNIST_MLP)])
+    loss, pm = model.evaluate(x, y)
+    assert pm.accuracy >= 0.9
+
+
+def test_functional_cnn_trains():
+    """func_cifar10_cnn pattern: functional API with conv/pool stack."""
+    x, y = _learnable_data(n=128, shape=(3, 12, 12), classes=4, seed=1)
+    cfg = ff.FFConfig(batch_size=32, compute_dtype="float32")
+    inp = Input((3, 12, 12))
+    t = Conv2D(8, (3, 3), strides=(1, 1), padding="same",
+               activation="relu")(inp)
+    t = MaxPooling2D((2, 2))(t)
+    t = Flatten()(t)
+    t = Dense(32, activation="relu")(t)
+    out = Activation("softmax")(Dense(4)(t))
+    model = Model(inp, out)
+    model.compile(keras.SGD(learning_rate=0.1),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], config=cfg)
+    first = model.fit(x, y, epochs=1, verbose=0).accuracy
+    last = model.fit(x, y, epochs=5, verbose=0).accuracy
+    assert last > first
+
+
+def test_functional_concat_model():
+    """Nested/concat functional coverage (func_*_concat examples)."""
+    from flexflow_tpu.keras import Concatenate
+    x, y = _learnable_data(n=128, shape=(8,), classes=4, seed=2)
+    cfg = ff.FFConfig(batch_size=32, compute_dtype="float32")
+    inp = Input((8,))
+    a = Dense(16, activation="relu")(inp)
+    b = Dense(16, activation="tanh")(inp)
+    t = Concatenate(axis=1)([a, b])
+    out = Activation("softmax")(Dense(4)(t))
+    model = Model(inp, out)
+    model.compile(keras.Adam(learning_rate=0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], config=cfg)
+    model.fit(x, y, epochs=3, verbose=0)
+    assert model.get_perf_metrics().accuracy > 0.5
+
+
+def test_keras_layer_weight_access():
+    """get_layer().get_weights()/set_weights round-trip (reference
+    model.get_layer weight-tensor pattern, base_model.py)."""
+    x, y = _learnable_data(n=64, shape=(6,), classes=3, seed=3)
+    cfg = ff.FFConfig(batch_size=32, compute_dtype="float32")
+    model = Sequential([Dense(8, activation="relu", input_shape=(6,),
+                              name="d0"),
+                        Dense(3, name="d1"), Activation("softmax")])
+    model.compile(keras.SGD(), loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], config=cfg)
+    k, b = model.get_layer("d0").get_weights()
+    assert k.shape == (8, 6) and b.shape == (8,)
+    model.get_layer("d0").set_weights([np.ones_like(k), b])
+    k2, _ = model.get_layer("d0").get_weights()
+    np.testing.assert_allclose(k2, 1.0)
+
+
+def test_keras_dataset_fallbacks():
+    (xtr, ytr), (xte, yte) = keras.datasets.mnist.load_data()
+    assert xtr.shape[1:] == (28, 28) and len(xtr) == len(ytr)
+    (xtr, ytr), (xte, yte) = keras.datasets.cifar10.load_data()
+    assert xtr.shape[1:] == (3, 32, 32)
+
+
+def test_lr_scheduler_and_early_stop_callbacks():
+    """on_epoch_begin fires (LearningRateScheduler) and EpochVerifyMetrics
+    early-stops the epoch loop once the bound is reached."""
+    from flexflow_tpu.keras import EpochVerifyMetrics, LearningRateScheduler
+
+    x, y = _learnable_data()
+    cfg = ff.FFConfig(batch_size=32, compute_dtype="float32")
+    model = Sequential([Dense(64, activation="relu", input_shape=(12,)),
+                        Dense(4), Activation("softmax")])
+    model.compile(keras.SGD(learning_rate=0.05),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], config=cfg)
+    lrs = []
+    sched = LearningRateScheduler(lambda e: 0.2 / (e + 1))
+    stopper = EpochVerifyMetrics(ModelAccuracy.MNIST_MLP)
+    orig = sched.on_epoch_begin
+
+    def spy(epoch, logs=None):
+        orig(epoch, logs)
+        lrs.append(model.ffmodel.optimizer.lr)
+
+    sched.on_epoch_begin = spy
+    model.fit(x, y, epochs=20, verbose=0, callbacks=[sched, stopper])
+    assert lrs and lrs[0] == pytest.approx(0.2)
+    assert stopper.reached
+    assert len(lrs) < 20  # early-stopped
+
+
+def test_shared_layer_reuse_raises():
+    d = Dense(4)
+    a, b = Input((8,)), Input((8,))
+    y1 = d(a)
+    y2 = d(b)
+    with pytest.raises(ValueError, match="more than once"):
+        Model([a, b], [y1, y2]).compile(
+            keras.SGD(), loss="sparse_categorical_crossentropy",
+            metrics=["accuracy"],
+            config=ff.FFConfig(batch_size=8, compute_dtype="float32"))
+
+
+def test_frontends_use_cli_default_config():
+    import flexflow_tpu
+    cfg = ff.FFConfig(batch_size=48, compute_dtype="float32")
+    flexflow_tpu.set_default_config(cfg)
+    try:
+        m = Sequential([Dense(4, input_shape=(8,)), Activation("softmax")])
+        m.compile(keras.SGD(), loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+        assert m.ffconfig.batch_size == 48
+        # fresh copy per model: compile() mutations don't leak
+        assert m.ffconfig is not cfg
+
+        from flexflow_tpu.torch import nn
+        mod = nn.Module()
+        assert mod.ffconfig.batch_size == 48
+    finally:
+        flexflow_tpu.set_default_config(None)
+        flexflow_tpu._default_config = None
+
+
+def test_torch_module_alexnet_style():
+    """reference examples/python/native/alexnet_torch.py pattern."""
+    from flexflow_tpu.torch import nn
+
+    class Net(nn.Module):
+        def __init__(self, cfg):
+            super().__init__(cfg)
+            self.conv1 = nn.Conv2d(3, 8, kernel_size=3, stride=1, padding=1)
+            self.relu1 = nn.ReLU()
+            self.pool1 = nn.MaxPool2d(kernel_size=2, stride=2)
+            self.flat = nn.Flatten()
+            self.fc1 = nn.Linear(8 * 6 * 6, 32)
+            self.relu2 = nn.ReLU()
+            self.fc2 = nn.Linear(32, 4)
+            self.softmax = nn.Softmax()
+
+        def forward(self, x):
+            x = self.pool1(self.relu1(self.conv1(x)))
+            x = self.relu2(self.fc1(self.flat(x)))
+            return self.softmax(self.fc2(x))
+
+    x, y = _learnable_data(n=64, shape=(3, 12, 12), classes=4, seed=4)
+    cfg = ff.FFConfig(batch_size=32, compute_dtype="float32")
+    net = Net(cfg)
+    out = net(net.create_input((32, 3, 12, 12)))
+    net.compile(ff.SGDOptimizer(lr=0.1),
+                "sparse_categorical_crossentropy", ["accuracy"])
+    losses = [float(net.ffmodel.train_batch(x[:32], y[:32]))
+              for _ in range(5)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    preds = net.predict(x[:32])
+    assert preds.shape == (32, 4)
