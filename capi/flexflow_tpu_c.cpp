@@ -1,0 +1,598 @@
+// flexflow_tpu_c.cpp — C API implementation (see flexflow_tpu_c.h).
+//
+// Embeds CPython and dispatches every call into the flexflow_tpu package;
+// handles are owned PyObject references.  Host buffers are wrapped as
+// numpy arrays via memoryviews (no numpy C API dependency) — the copy to
+// device memory happens inside train_batch's _shard_batch, mirroring the
+// reference dataloader's host->FB copy (flexflow_dataloader.cc:260-330).
+
+#include "flexflow_tpu_c.h"
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::string g_err;
+
+void set_err_from_python() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    g_err = s ? PyUnicode_AsUTF8(s) : "unknown python error";
+    Py_XDECREF(s);
+  } else {
+    g_err = "unknown error";
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+PyObject* g_ff = nullptr;  // flexflow_tpu module
+PyObject* g_np = nullptr;  // numpy module
+
+struct Handle {
+  PyObject* obj;
+};
+
+PyObject* obj(void* h) { return reinterpret_cast<Handle*>(h)->obj; }
+
+void* wrap(PyObject* o) {
+  if (!o) return nullptr;
+  Handle* h = new Handle{o};
+  return h;
+}
+
+void unwrap_free(void* h) {
+  if (!h) return;
+  Handle* hh = reinterpret_cast<Handle*>(h);
+  Py_XDECREF(hh->obj);
+  delete hh;
+}
+
+const char* act_name(flexflow_activation_t a) {
+  switch (a) {
+    case FF_AC_RELU: return "relu";
+    case FF_AC_SIGMOID: return "sigmoid";
+    case FF_AC_TANH: return "tanh";
+    case FF_AC_GELU: return "gelu";
+    default: return nullptr;
+  }
+}
+
+const char* loss_name(flexflow_loss_t l) {
+  switch (l) {
+    case FF_LOSS_CCE: return "categorical_crossentropy";
+    case FF_LOSS_MSE: return "mean_squared_error";
+    default: return "sparse_categorical_crossentropy";
+  }
+}
+
+// numpy array viewing a host buffer: np.frombuffer(memoryview, dtype)
+// .reshape(shape).  Returns a new reference or nullptr.
+PyObject* buffer_to_ndarray(const void* data, PyObject* shape_tuple,
+                            const char* dtype) {
+  Py_ssize_t n = 1;
+  for (Py_ssize_t i = 0; i < PyTuple_Size(shape_tuple); i++)
+    n *= PyLong_AsLongLong(PyTuple_GetItem(shape_tuple, i));
+  Py_ssize_t nbytes = n * 4;  // float32 / int32
+  PyObject* mv = PyMemoryView_FromMemory(
+      const_cast<char*>(static_cast<const char*>(data)), nbytes, PyBUF_READ);
+  if (!mv) return nullptr;
+  PyObject* flat = PyObject_CallMethod(g_np, "frombuffer", "Os", mv, dtype);
+  Py_DECREF(mv);
+  if (!flat) return nullptr;
+  PyObject* arr = PyObject_CallMethod(flat, "reshape", "O", shape_tuple);
+  Py_DECREF(flat);
+  return arr;
+}
+
+// shapes+dtypes of the model's graph inputs followed by the label tensor
+PyObject* model_feed_specs(PyObject* model) {
+  // returns list of (shape tuple, dtype str) — inputs then label
+  PyObject* specs = PyList_New(0);
+  PyObject* inputs = PyObject_GetAttrString(model, "input_tensors");
+  if (!inputs) return nullptr;
+  Py_ssize_t n = PyList_Size(inputs);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject* t = PyList_GetItem(inputs, i);  // borrowed
+    PyObject* shape = PyObject_GetAttrString(t, "shape");
+    PyObject* dtype = PyObject_GetAttrString(t, "dtype");
+    PyObject* pair = PyTuple_Pack(2, shape, dtype);
+    PyList_Append(specs, pair);
+    Py_DECREF(pair);
+    Py_DECREF(shape);
+    Py_DECREF(dtype);
+  }
+  Py_DECREF(inputs);
+  PyObject* label = PyObject_GetAttrString(model, "label_tensor");
+  if (label && label != Py_None) {
+    PyObject* shape = PyObject_GetAttrString(label, "shape");
+    PyObject* dtype = PyObject_GetAttrString(label, "dtype");
+    PyObject* pair = PyTuple_Pack(2, shape, dtype);
+    PyList_Append(specs, pair);
+    Py_DECREF(pair);
+    Py_DECREF(shape);
+    Py_DECREF(dtype);
+  }
+  Py_XDECREF(label);
+  return specs;
+}
+
+// build the python arg tuple (x0, x1, ..., label) from raw buffers
+PyObject* marshal_batch(PyObject* model, int n_inputs, const void** inputs,
+                        const void* label) {
+  PyObject* specs = model_feed_specs(model);
+  if (!specs) return nullptr;
+  if (PyList_Size(specs) != n_inputs + 1) {
+    g_err = "input count mismatch: model expects " +
+            std::to_string(PyList_Size(specs) - 1) + " inputs";
+    Py_DECREF(specs);
+    return nullptr;
+  }
+  PyObject* args = PyTuple_New(n_inputs + 1);
+  for (int i = 0; i <= n_inputs; i++) {
+    PyObject* pair = PyList_GetItem(specs, i);  // borrowed
+    PyObject* shape = PyTuple_GetItem(pair, 0);
+    const char* dtype = PyUnicode_AsUTF8(PyTuple_GetItem(pair, 1));
+    const void* buf = (i < n_inputs) ? inputs[i] : label;
+    PyObject* arr = buffer_to_ndarray(buf, shape, dtype);
+    if (!arr) {
+      Py_DECREF(specs);
+      Py_DECREF(args);
+      return nullptr;
+    }
+    PyTuple_SetItem(args, i, arr);  // steals
+  }
+  Py_DECREF(specs);
+  return args;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* flexflow_last_error(void) { return g_err.c_str(); }
+
+int flexflow_init(void) {
+  if (g_ff) return 0;
+  if (!Py_IsInitialized()) Py_InitializeEx(0);
+  g_ff = PyImport_ImportModule("flexflow_tpu");
+  if (!g_ff) {
+    set_err_from_python();
+    return -1;
+  }
+  g_np = PyImport_ImportModule("numpy");
+  if (!g_np) {
+    set_err_from_python();
+    return -1;
+  }
+  return 0;
+}
+
+void flexflow_finalize(void) {
+  Py_XDECREF(g_ff);
+  Py_XDECREF(g_np);
+  g_ff = g_np = nullptr;
+}
+
+/* ---- config ---- */
+
+flexflow_config_t flexflow_config_create(int argc, char** argv) {
+  if (flexflow_init() != 0) return nullptr;
+  PyObject* lst = PyList_New(0);
+  for (int i = 0; i < argc; i++) {
+    PyObject* s = PyUnicode_FromString(argv[i]);
+    PyList_Append(lst, s);
+    Py_DECREF(s);
+  }
+  PyObject* cls = PyObject_GetAttrString(g_ff, "FFConfig");
+  PyObject* cfg = PyObject_CallMethod(cls, "parse_args", "O", lst);
+  Py_DECREF(cls);
+  Py_DECREF(lst);
+  if (!cfg) {
+    set_err_from_python();
+    return nullptr;
+  }
+  return (flexflow_config_t)wrap(cfg);
+}
+
+void flexflow_config_destroy(flexflow_config_t c) { unwrap_free(c); }
+
+static int get_int_attr(void* h, const char* name) {
+  PyObject* v = PyObject_GetAttrString(obj(h), name);
+  if (!v) {
+    set_err_from_python();
+    return -1;
+  }
+  long r = PyLong_AsLong(v);
+  Py_DECREF(v);
+  return (int)r;
+}
+
+int flexflow_config_get_batch_size(flexflow_config_t c) {
+  return get_int_attr(c, "batch_size");
+}
+int flexflow_config_get_epochs(flexflow_config_t c) {
+  return get_int_attr(c, "epochs");
+}
+int flexflow_config_get_workers_per_node(flexflow_config_t c) {
+  return get_int_attr(c, "workers_per_node");
+}
+
+/* ---- model + tensors ---- */
+
+flexflow_model_t flexflow_model_create(flexflow_config_t c) {
+  if (flexflow_init() != 0) return nullptr;
+  PyObject* cls = PyObject_GetAttrString(g_ff, "FFModel");
+  PyObject* m = PyObject_CallFunctionObjArgs(cls, obj(c), nullptr);
+  Py_DECREF(cls);
+  if (!m) {
+    set_err_from_python();
+    return nullptr;
+  }
+  return (flexflow_model_t)wrap(m);
+}
+
+void flexflow_model_destroy(flexflow_model_t m) { unwrap_free(m); }
+void flexflow_tensor_destroy(flexflow_tensor_t t) { unwrap_free(t); }
+
+flexflow_tensor_t flexflow_model_create_tensor(
+    flexflow_model_t m, int ndims, const int64_t* dims,
+    flexflow_datatype_t dtype, const char* name) {
+  PyObject* shape = PyTuple_New(ndims);
+  for (int i = 0; i < ndims; i++)
+    PyTuple_SetItem(shape, i, PyLong_FromLongLong(dims[i]));
+  PyObject* t = PyObject_CallMethod(
+      obj(m), "create_tensor", "Oss", shape,
+      dtype == FF_DT_INT32 ? "int32" : "float32",
+      name ? name : "input");
+  Py_DECREF(shape);
+  if (!t) {
+    set_err_from_python();
+    return nullptr;
+  }
+  return (flexflow_tensor_t)wrap(t);
+}
+
+int flexflow_tensor_get_ndims(flexflow_tensor_t t) {
+  PyObject* shape = PyObject_GetAttrString(obj(t), "shape");
+  int n = (int)PyTuple_Size(shape);
+  Py_DECREF(shape);
+  return n;
+}
+
+int64_t flexflow_tensor_get_dim(flexflow_tensor_t t, int idx) {
+  PyObject* shape = PyObject_GetAttrString(obj(t), "shape");
+  int64_t v = PyLong_AsLongLong(PyTuple_GetItem(shape, idx));
+  Py_DECREF(shape);
+  return v;
+}
+
+/* ---- op adders ---- */
+
+static flexflow_tensor_t call_op(PyObject* result) {
+  if (!result) {
+    set_err_from_python();
+    return nullptr;
+  }
+  return (flexflow_tensor_t)wrap(result);
+}
+
+// method call with positional args (format) + keyword dict built from
+// NULL-terminated (key, PyObject* new-ref) pairs
+static PyObject* call_kw(PyObject* o, const char* meth, PyObject* args,
+                         PyObject* kwargs) {
+  PyObject* f = PyObject_GetAttrString(o, meth);
+  if (!f) return nullptr;
+  PyObject* r = PyObject_Call(f, args, kwargs);
+  Py_DECREF(f);
+  Py_DECREF(args);
+  Py_XDECREF(kwargs);
+  return r;
+}
+
+static void kw_set_str(PyObject* kw, const char* k, const char* v) {
+  if (!v) return;
+  PyObject* s = PyUnicode_FromString(v);
+  PyDict_SetItemString(kw, k, s);
+  Py_DECREF(s);
+}
+
+static void kw_set_bool(PyObject* kw, const char* k, int v) {
+  PyDict_SetItemString(kw, k, v ? Py_True : Py_False);
+}
+
+flexflow_tensor_t flexflow_model_conv2d(
+    flexflow_model_t m, flexflow_tensor_t input, int out_channels,
+    int kernel_h, int kernel_w, int stride_h, int stride_w,
+    int padding_h, int padding_w, flexflow_activation_t activation,
+    int use_bias, const char* name) {
+  PyObject* args = Py_BuildValue("(Oiiiiiii)", obj(input), out_channels,
+                                 kernel_h, kernel_w, stride_h, stride_w,
+                                 padding_h, padding_w);
+  PyObject* kw = PyDict_New();
+  kw_set_str(kw, "activation", act_name(activation));
+  kw_set_bool(kw, "use_bias", use_bias);
+  kw_set_str(kw, "name", name);
+  return call_op(call_kw(obj(m), "conv2d", args, kw));
+}
+
+flexflow_tensor_t flexflow_model_pool2d(
+    flexflow_model_t m, flexflow_tensor_t input, int kernel_h, int kernel_w,
+    int stride_h, int stride_w, int padding_h, int padding_w,
+    int is_max_pool, const char* name) {
+  PyObject* args = Py_BuildValue("(Oiiiiii)", obj(input), kernel_h, kernel_w,
+                                 stride_h, stride_w, padding_h, padding_w);
+  PyObject* kw = PyDict_New();
+  kw_set_str(kw, "pool_type", is_max_pool ? "max" : "avg");
+  kw_set_str(kw, "name", name);
+  return call_op(call_kw(obj(m), "pool2d", args, kw));
+}
+
+flexflow_tensor_t flexflow_model_dense(
+    flexflow_model_t m, flexflow_tensor_t input, int out_dim,
+    flexflow_activation_t activation, int use_bias, const char* name) {
+  PyObject* args = Py_BuildValue("(Oi)", obj(input), out_dim);
+  PyObject* kw = PyDict_New();
+  kw_set_str(kw, "activation", act_name(activation));
+  kw_set_bool(kw, "use_bias", use_bias);
+  kw_set_str(kw, "name", name);
+  return call_op(call_kw(obj(m), "dense", args, kw));
+}
+
+flexflow_tensor_t flexflow_model_embedding(
+    flexflow_model_t m, flexflow_tensor_t input, int num_entries,
+    int out_dim, const char* name) {
+  PyObject* args = Py_BuildValue("(Oii)", obj(input), num_entries, out_dim);
+  PyObject* kw = PyDict_New();
+  kw_set_str(kw, "name", name);
+  return call_op(call_kw(obj(m), "embedding", args, kw));
+}
+
+flexflow_tensor_t flexflow_model_flat(flexflow_model_t m,
+                                      flexflow_tensor_t input,
+                                      const char* name) {
+  PyObject* args = Py_BuildValue("(O)", obj(input));
+  PyObject* kw = PyDict_New();
+  kw_set_str(kw, "name", name);
+  return call_op(call_kw(obj(m), "flat", args, kw));
+}
+
+flexflow_tensor_t flexflow_model_softmax(flexflow_model_t m,
+                                         flexflow_tensor_t input,
+                                         const char* name) {
+  PyObject* args = Py_BuildValue("(O)", obj(input));
+  PyObject* kw = PyDict_New();
+  kw_set_str(kw, "name", name);
+  return call_op(call_kw(obj(m), "softmax", args, kw));
+}
+
+flexflow_tensor_t flexflow_model_concat(flexflow_model_t m, int n,
+                                        flexflow_tensor_t* inputs, int axis,
+                                        const char* name) {
+  PyObject* lst = PyList_New(n);
+  for (int i = 0; i < n; i++) {
+    Py_INCREF(obj(inputs[i]));
+    PyList_SetItem(lst, i, obj(inputs[i]));
+  }
+  PyObject* args = Py_BuildValue("(Oi)", lst, axis);
+  Py_DECREF(lst);
+  PyObject* kw = PyDict_New();
+  kw_set_str(kw, "name", name);
+  return call_op(call_kw(obj(m), "concat", args, kw));
+}
+
+flexflow_tensor_t flexflow_model_add(flexflow_model_t m, flexflow_tensor_t a,
+                                     flexflow_tensor_t b, const char* name) {
+  PyObject* args = Py_BuildValue("(OO)", obj(a), obj(b));
+  PyObject* kw = PyDict_New();
+  kw_set_str(kw, "name", name);
+  return call_op(call_kw(obj(m), "add", args, kw));
+}
+
+flexflow_tensor_t flexflow_model_dropout(flexflow_model_t m,
+                                         flexflow_tensor_t input, float rate,
+                                         const char* name) {
+  PyObject* args = Py_BuildValue("(Od)", obj(input), (double)rate);
+  PyObject* kw = PyDict_New();
+  kw_set_str(kw, "name", name);
+  return call_op(call_kw(obj(m), "dropout", args, kw));
+}
+
+flexflow_tensor_t flexflow_model_batch_norm(flexflow_model_t m,
+                                            flexflow_tensor_t input, int relu,
+                                            const char* name) {
+  PyObject* args = Py_BuildValue("(O)", obj(input));
+  PyObject* kw = PyDict_New();
+  kw_set_bool(kw, "relu", relu);
+  kw_set_str(kw, "name", name);
+  return call_op(call_kw(obj(m), "batch_norm", args, kw));
+}
+
+flexflow_tensor_t flexflow_model_mse_loss(flexflow_model_t m,
+                                          flexflow_tensor_t logits,
+                                          const char* reduction,
+                                          const char* name) {
+  PyObject* args = Py_BuildValue("(O)", obj(logits));
+  PyObject* kw = PyDict_New();
+  kw_set_str(kw, "reduction", reduction ? reduction : "average");
+  kw_set_str(kw, "name", name);
+  return call_op(call_kw(obj(m), "mse_loss", args, kw));
+}
+
+/* ---- compile + verbs ---- */
+
+int flexflow_model_compile(flexflow_model_t m, flexflow_optimizer_t opt,
+                           double lr, flexflow_loss_t loss,
+                           flexflow_tensor_t final_tensor) {
+  PyObject* cls = PyObject_GetAttrString(
+      g_ff, opt == FF_OPT_ADAM ? "AdamOptimizer" : "SGDOptimizer");
+  PyObject* okw = PyDict_New();
+  PyObject* lrv = PyFloat_FromDouble(lr);
+  PyDict_SetItemString(okw, opt == FF_OPT_ADAM ? "alpha" : "lr", lrv);
+  Py_DECREF(lrv);
+  PyObject* empty = PyTuple_New(0);
+  PyObject* opt_obj = PyObject_Call(cls, empty, okw);
+  Py_DECREF(cls);
+  Py_DECREF(empty);
+  Py_DECREF(okw);
+  if (!opt_obj) {
+    set_err_from_python();
+    return -1;
+  }
+  PyObject* args = Py_BuildValue("(Os)", opt_obj, loss_name(loss));
+  PyObject* kw = PyDict_New();
+  PyDict_SetItemString(kw, "final_tensor",
+                       final_tensor ? obj(final_tensor) : Py_None);
+  PyObject* r = call_kw(obj(m), "compile", args, kw);
+  Py_DECREF(opt_obj);
+  if (!r) {
+    set_err_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int flexflow_model_init_layers(flexflow_model_t m, int seed) {
+  PyObject* r = PyObject_CallMethod(obj(m), "init_layers", "i", seed);
+  if (!r) {
+    set_err_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+double flexflow_model_train_batch(flexflow_model_t m, int n_inputs,
+                                  const void** inputs, const void* label) {
+  PyObject* args = marshal_batch(obj(m), n_inputs, inputs, label);
+  if (!args) {
+    if (PyErr_Occurred()) set_err_from_python();
+    return (double)NAN;
+  }
+  PyObject* fn = PyObject_GetAttrString(obj(m), "train_batch");
+  PyObject* loss = fn ? PyObject_CallObject(fn, args) : nullptr;
+  Py_XDECREF(fn);
+  Py_DECREF(args);
+  if (!loss) {
+    set_err_from_python();
+    return (double)NAN;
+  }
+  double v = PyFloat_AsDouble(loss);
+  Py_DECREF(loss);
+  return v;
+}
+
+int flexflow_model_set_batch(flexflow_model_t m, int n_inputs,
+                             const void** inputs, const void* label) {
+  PyObject* args = marshal_batch(obj(m), n_inputs, inputs, label);
+  if (!args) {
+    if (PyErr_Occurred()) set_err_from_python();
+    return -1;
+  }
+  PyObject* fn = PyObject_GetAttrString(obj(m), "set_batch");
+  PyObject* r = fn ? PyObject_CallObject(fn, args) : nullptr;
+  Py_XDECREF(fn);
+  Py_DECREF(args);
+  if (!r) {
+    set_err_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+static int call_verb(flexflow_model_t m, const char* verb) {
+  PyObject* r = PyObject_CallMethod(obj(m), verb, nullptr);
+  if (!r) {
+    set_err_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int flexflow_model_forward(flexflow_model_t m) {
+  return call_verb(m, "forward");
+}
+int flexflow_model_zero_gradients(flexflow_model_t m) {
+  return call_verb(m, "zero_gradients");
+}
+int flexflow_model_update(flexflow_model_t m) { return call_verb(m, "update"); }
+
+double flexflow_model_backward(flexflow_model_t m) {
+  PyObject* r = PyObject_CallMethod(obj(m), "backward", nullptr);
+  if (!r) {
+    set_err_from_python();
+    return (double)NAN;
+  }
+  double v = PyFloat_AsDouble(r);
+  Py_DECREF(r);
+  return v;
+}
+
+/* ---- weights ---- */
+
+int64_t flexflow_model_get_weights(flexflow_model_t m, const char* name,
+                                   float* buf, int64_t capacity) {
+  PyObject* w = PyObject_CallMethod(obj(m), "get_weights", "s", name);
+  if (!w) {
+    set_err_from_python();
+    return -1;
+  }
+  PyObject* w32 = PyObject_CallMethod(w, "astype", "s", "float32");
+  Py_DECREF(w);
+  if (!w32) {
+    set_err_from_python();
+    return -1;
+  }
+  PyObject* flat = PyObject_CallMethod(w32, "ravel", nullptr);
+  Py_DECREF(w32);
+  PyObject* size = PyObject_GetAttrString(flat, "size");
+  int64_t n = PyLong_AsLongLong(size);
+  Py_DECREF(size);
+  if (buf) {
+    if (capacity < n) {
+      g_err = "buffer too small";
+      Py_DECREF(flat);
+      return -1;
+    }
+    PyObject* bytes = PyObject_CallMethod(flat, "tobytes", nullptr);
+    memcpy(buf, PyBytes_AsString(bytes), (size_t)n * 4);
+    Py_DECREF(bytes);
+  }
+  Py_DECREF(flat);
+  return n;
+}
+
+int flexflow_model_set_weights(flexflow_model_t m, const char* name,
+                               const float* buf, int64_t count) {
+  PyObject* mv = PyMemoryView_FromMemory(
+      const_cast<char*>(reinterpret_cast<const char*>(buf)), count * 4,
+      PyBUF_READ);
+  PyObject* arr = PyObject_CallMethod(g_np, "frombuffer", "Os", mv,
+                                      "float32");
+  Py_DECREF(mv);
+  if (!arr) {
+    set_err_from_python();
+    return -1;
+  }
+  PyObject* r = PyObject_CallMethod(obj(m), "set_weights", "sO", name, arr);
+  Py_DECREF(arr);
+  if (!r) {
+    set_err_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,125 @@
+/* flexflow_tpu_c.h — flat C API for the flexflow_tpu framework.
+ *
+ * Mirrors the reference's C surface (python/flexflow_c.h:49-125: opaque
+ * handles for FFConfig/FFModel/Tensor plus per-op adders and training
+ * verbs), so a non-Python host — or a cffi binding — can drive the full
+ * graph-build / compile / train loop.  The implementation
+ * (flexflow_tpu_c.cpp) embeds CPython and dispatches to the Python core:
+ * on TPU the runtime under every call is the same fused XLA program, so the
+ * C layer is a thin veneer by design rather than a 2k-LoC re-implementation.
+ *
+ * Build:  g++ -O2 -shared -fPIC flexflow_tpu_c.cpp \
+ *             $(python3-config --includes) $(python3-config --ldflags --embed) \
+ *             -o libflexflow_tpu_c.so
+ */
+
+#ifndef FLEXFLOW_TPU_C_H
+#define FLEXFLOW_TPU_C_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct flexflow_config_st* flexflow_config_t;
+typedef struct flexflow_model_st* flexflow_model_t;
+typedef struct flexflow_tensor_st* flexflow_tensor_t;
+
+typedef enum { FF_DT_FLOAT = 0, FF_DT_INT32 = 1 } flexflow_datatype_t;
+typedef enum { FF_AC_NONE = 0, FF_AC_RELU = 1, FF_AC_SIGMOID = 2,
+               FF_AC_TANH = 3, FF_AC_GELU = 4 } flexflow_activation_t;
+typedef enum { FF_OPT_SGD = 0, FF_OPT_ADAM = 1 } flexflow_optimizer_t;
+typedef enum { FF_LOSS_SPARSE_CCE = 0, FF_LOSS_CCE = 1,
+               FF_LOSS_MSE = 2 } flexflow_loss_t;
+
+/* ---- runtime ---- */
+/* Initialize the embedded runtime; safe to call more than once.
+ * Returns 0 on success. */
+int flexflow_init(void);
+void flexflow_finalize(void);
+/* Last error message ("" when none). */
+const char* flexflow_last_error(void);
+
+/* ---- config (reference flexflow_c.h: flexflow_config_*) ---- */
+flexflow_config_t flexflow_config_create(int argc, char** argv);
+void flexflow_config_destroy(flexflow_config_t);
+int flexflow_config_get_batch_size(flexflow_config_t);
+int flexflow_config_get_epochs(flexflow_config_t);
+int flexflow_config_get_workers_per_node(flexflow_config_t);
+
+/* ---- model + tensors ---- */
+flexflow_model_t flexflow_model_create(flexflow_config_t);
+void flexflow_model_destroy(flexflow_model_t);
+flexflow_tensor_t flexflow_model_create_tensor(
+    flexflow_model_t, int ndims, const int64_t* dims,
+    flexflow_datatype_t dtype, const char* name);
+void flexflow_tensor_destroy(flexflow_tensor_t);
+int flexflow_tensor_get_ndims(flexflow_tensor_t);
+int64_t flexflow_tensor_get_dim(flexflow_tensor_t, int idx);
+
+/* ---- op adders (reference flexflow_c.h per-op surface from :133) ---- */
+flexflow_tensor_t flexflow_model_conv2d(
+    flexflow_model_t, flexflow_tensor_t input, int out_channels,
+    int kernel_h, int kernel_w, int stride_h, int stride_w,
+    int padding_h, int padding_w, flexflow_activation_t activation,
+    int use_bias, const char* name);
+flexflow_tensor_t flexflow_model_pool2d(
+    flexflow_model_t, flexflow_tensor_t input, int kernel_h, int kernel_w,
+    int stride_h, int stride_w, int padding_h, int padding_w,
+    int is_max_pool, const char* name);
+flexflow_tensor_t flexflow_model_dense(
+    flexflow_model_t, flexflow_tensor_t input, int out_dim,
+    flexflow_activation_t activation, int use_bias, const char* name);
+flexflow_tensor_t flexflow_model_embedding(
+    flexflow_model_t, flexflow_tensor_t input, int num_entries, int out_dim,
+    const char* name);
+flexflow_tensor_t flexflow_model_flat(flexflow_model_t, flexflow_tensor_t,
+                                      const char* name);
+flexflow_tensor_t flexflow_model_softmax(flexflow_model_t, flexflow_tensor_t,
+                                         const char* name);
+flexflow_tensor_t flexflow_model_concat(flexflow_model_t, int n,
+                                        flexflow_tensor_t* inputs, int axis,
+                                        const char* name);
+flexflow_tensor_t flexflow_model_add(flexflow_model_t, flexflow_tensor_t,
+                                     flexflow_tensor_t, const char* name);
+flexflow_tensor_t flexflow_model_dropout(flexflow_model_t, flexflow_tensor_t,
+                                         float rate, const char* name);
+flexflow_tensor_t flexflow_model_batch_norm(flexflow_model_t,
+                                            flexflow_tensor_t, int relu,
+                                            const char* name);
+flexflow_tensor_t flexflow_model_mse_loss(flexflow_model_t, flexflow_tensor_t,
+                                          const char* reduction,
+                                          const char* name);
+
+/* ---- compile + training verbs (reference flexflow_c.h:86-125) ---- */
+int flexflow_model_compile(flexflow_model_t, flexflow_optimizer_t opt,
+                           double lr, flexflow_loss_t loss,
+                           flexflow_tensor_t final_tensor /* or NULL */);
+int flexflow_model_init_layers(flexflow_model_t, int seed);
+/* One fused training step on host buffers (row-major, batch-major).
+ * inputs[i] points at the i-th graph input; label is the label buffer.
+ * Returns the loss, or NaN on error. */
+double flexflow_model_train_batch(flexflow_model_t, int n_inputs,
+                                  const void** inputs, const void* label);
+/* Legacy verb API: set_batch then forward/zero_gradients/backward/update. */
+int flexflow_model_set_batch(flexflow_model_t, int n_inputs,
+                             const void** inputs, const void* label);
+int flexflow_model_forward(flexflow_model_t);
+int flexflow_model_zero_gradients(flexflow_model_t);
+double flexflow_model_backward(flexflow_model_t);
+int flexflow_model_update(flexflow_model_t);
+
+/* ---- weights I/O (reference Parameter::get/set_weights) ---- */
+/* Copies the named parameter into buf (float32); returns element count,
+ * or -1 on error. Pass buf=NULL to query the size. */
+int64_t flexflow_model_get_weights(flexflow_model_t, const char* name,
+                                   float* buf, int64_t capacity);
+int flexflow_model_set_weights(flexflow_model_t, const char* name,
+                               const float* buf, int64_t count);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* FLEXFLOW_TPU_C_H */

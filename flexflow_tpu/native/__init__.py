@@ -1,0 +1,73 @@
+"""Native components: the C++ event-driven simulator (simulator.cpp) —
+the reference's search hot loop is likewise native (simulator.cc).
+
+The shared library is built on demand with g++ (no third-party deps) and
+loaded via ctypes; everything degrades to the pure-Python implementation
+when no compiler is available.  ``load_ffsim()`` returns None in that case.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import warnings
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "simulator.cpp")
+_LIB = os.path.join(_DIR, f"libffsim-{sys.platform}.so")
+
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        r = subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC,
+             "-o", _LIB],
+            capture_output=True, text=True, timeout=120)
+        if r.returncode != 0:
+            warnings.warn(f"native simulator build failed: {r.stderr[:500]}")
+            return False
+        return True
+    except (OSError, subprocess.TimeoutExpired) as e:
+        warnings.warn(f"native simulator build unavailable: {e}")
+        return False
+
+
+def load_ffsim() -> Optional[ctypes.CDLL]:
+    """The compiled simulator library, building it on first use."""
+    global _lib, _tried
+    if _lib is not None:
+        return _lib
+    if _tried:
+        return None
+    _tried = True
+    if not os.path.exists(_LIB) or (
+            os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+        if not _build():
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB)
+    except OSError as e:
+        warnings.warn(f"native simulator load failed: {e}")
+        return None
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    lib.ffsim_simulate.restype = ctypes.c_double
+    lib.ffsim_simulate.argtypes = [
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        f64p, f64p, f64p,          # fwd, bwd, sync times
+        i32p, i64p, i64p,          # rank, out_shape, out_dims
+        i32p, i32p,                # dev_off, dev_ids
+        i32p, i32p, i32p, i64p,    # in_off, in_producer, in_rank, in_shape
+        ctypes.c_int32,
+        ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_double,
+    ]
+    lib.ffsim_version.restype = ctypes.c_int32
+    _lib = lib
+    return _lib

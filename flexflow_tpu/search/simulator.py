@@ -75,13 +75,18 @@ def _overlap_volume(lo1, hi1, lo2, hi2) -> int:
 class Simulator:
     def __init__(self, spec: DeviceSpec = DEFAULT_SPEC,
                  num_devices: int = 1, devices_per_slice: int = 0,
-                 measure: bool = False, dtype_bytes: int = 2):
+                 measure: bool = False, dtype_bytes: int = 2,
+                 use_native: bool = True):
         self.spec = spec
         self.num_devices = num_devices
         self.devices_per_slice = devices_per_slice or num_devices
         self.measure = measure
         self.dtype_bytes = dtype_bytes
         self._measure_cache: Dict[Tuple, float] = {}
+        self._native = None
+        if use_native:
+            from ..native import load_ffsim
+            self._native = load_ffsim()
 
     # --------------------------------------------------------------
     def _op_time(self, op: Op, dims: Tuple[int, ...], backward: bool) -> float:
@@ -133,35 +138,141 @@ class Simulator:
             return float("inf")
 
     # --------------------------------------------------------------
+    def _op_plan(self, op: Op, strategies) -> Tuple:
+        """(pc, padded dims, fwd, bwd, sync) for one op — shared between the
+        Python and native simulators."""
+        pc = strategies.get(op.name)
+        if pc is None:
+            nd = op.outputs[0].num_dims
+            pc = ParallelConfig.data_parallel(
+                min(self.num_devices, op.outputs[0].shape[0]), nd)
+        out = op.outputs[0]
+        dims = pc.dims
+        if len(dims) != out.num_dims:
+            dims = tuple(dims[: out.num_dims]) + \
+                (1,) * max(0, out.num_dims - len(dims))
+        ft = self._op_time(op, dims, backward=False)
+        bt = self._op_time(op, dims, backward=True)
+        sync = 0.0
+        if op.weights:
+            from ..parallel.mesh import dim_axis_names
+            axes = dim_axis_names(out.num_dims)
+            # per-weight accounting: a channel split SHARDS a weight with a
+            # sharded_dim (replica groups span only the non-c degrees and
+            # each group moves 1/c of the bytes), while replicated weights
+            # (e.g. bias on a TP linear) still allreduce across ALL degrees
+            c_deg, repl = 1, 1
+            for deg, ax in zip(dims, axes):
+                if ax == "c":
+                    c_deg *= deg
+                else:
+                    repl *= deg
+            for w in op.weights:
+                if not w.trainable:
+                    continue
+                wb = w.volume * 4
+                if (w.sharded_dim is not None and c_deg > 1
+                        and w.shape[w.sharded_dim] % c_deg == 0):
+                    sync += allreduce_time(wb / c_deg,
+                                           min(repl, self.num_devices),
+                                           self.spec)
+                else:
+                    sync += allreduce_time(
+                        wb, min(repl * c_deg, self.num_devices), self.spec)
+        return pc, dims, ft, bt, sync
+
+    def _simulate_native(self, layers: List[Op],
+                         strategies: Dict[str, ParallelConfig],
+                         overlap_backward_update: bool) -> float:
+        """Marshal the model into flat arrays and run the C++ engine."""
+        import ctypes
+
+        MAXD = 4
+        n = len(layers)
+        fwd = np.zeros(n)
+        bwd = np.zeros(n)
+        sync = np.zeros(n)
+        rank = np.zeros(n, np.int32)
+        out_shape = np.zeros(n * MAXD, np.int64)
+        out_dims = np.ones(n * MAXD, np.int64)
+        dev_off = np.zeros(n + 1, np.int32)
+        dev_ids: List[int] = []
+        in_off = np.zeros(n + 1, np.int32)
+        in_prod: List[int] = []
+        in_rank: List[int] = []
+        in_shape: List[int] = []
+        uid_to_op = {op.outputs[0].uid: i for i, op in enumerate(layers)}
+        for i, op in enumerate(layers):
+            pc, dims, ft, bt, st = self._op_plan(op, strategies)
+            if not np.isfinite(ft) or not np.isfinite(bt):
+                return float("inf")
+            fwd[i], bwd[i], sync[i] = ft, bt, st
+            out = op.outputs[0]
+            rank[i] = out.num_dims
+            out_shape[i * MAXD: i * MAXD + out.num_dims] = out.shape
+            out_dims[i * MAXD: i * MAXD + len(dims)] = dims
+            dev_ids.extend(int(d) for d in pc.device_ids)
+            dev_off[i + 1] = len(dev_ids)
+            for t_in in op.inputs:
+                in_prod.append(uid_to_op.get(t_in.uid, -1))
+                in_rank.append(t_in.num_dims)
+                row = list(t_in.shape)[:MAXD]
+                in_shape.extend(row + [1] * (MAXD - len(row)))
+            in_off[i + 1] = len(in_prod)
+
+        def p(a, ct):
+            arr = np.ascontiguousarray(a)
+            return arr, arr.ctypes.data_as(ctypes.POINTER(ct))
+
+        ka = []  # keep-alive
+
+        def q(a, ct):
+            arr, ptr = p(a, ct)
+            ka.append(arr)
+            return ptr
+
+        i32, i64, f64 = ctypes.c_int32, ctypes.c_int64, ctypes.c_double
+        return float(self._native.ffsim_simulate(
+            n, self.num_devices, self.devices_per_slice,
+            q(fwd, f64), q(bwd, f64), q(sync, f64),
+            q(rank, i32), q(out_shape, i64), q(out_dims, i64),
+            q(dev_off, i32), q(np.asarray(dev_ids, np.int32), i32),
+            q(in_off, i32), q(np.asarray(in_prod, np.int32), i32),
+            q(np.asarray(in_rank, np.int32), i32),
+            q(np.asarray(in_shape, np.int64), i64),
+            1 if overlap_backward_update else 0,
+            self.spec.ici_bw, self.spec.dcn_bw, self.spec.ici_latency,
+            float(self.dtype_bytes)))
+
     def simulate(self, layers: List[Op],
                  strategies: Dict[str, ParallelConfig],
                  overlap_backward_update: bool = False) -> float:
         """Simulated per-iteration runtime (seconds) — the MCMC objective
-        (reference simulate_runtime, simulator.cc:275-448)."""
+        (reference simulate_runtime, simulator.cc:275-448).  Runs the C++
+        engine when available (native/simulator.cpp), else pure Python."""
+        if self._native is not None:
+            t = self._simulate_native(layers, strategies,
+                                      overlap_backward_update)
+            return float("inf") if t >= 1e29 else t
+        return self.simulate_py(layers, strategies, overlap_backward_update)
+
+    def simulate_py(self, layers: List[Op],
+                    strategies: Dict[str, ParallelConfig],
+                    overlap_backward_update: bool = False) -> float:
+        """Pure-Python reference implementation (and no-compiler fallback)."""
         tasks: List[SimTask] = []
         # per-(tensor uid) -> list of (coord-rect, fwd task, device)
         produced: Dict[int, List[Tuple]] = {}
         fwd_of: Dict[str, List[SimTask]] = {}
         bwd_of: Dict[str, List[SimTask]] = {}
-
-        def cfg_for(op: Op) -> ParallelConfig:
-            pc = strategies.get(op.name)
-            if pc is None:
-                nd = op.outputs[0].num_dims
-                pc = ParallelConfig.data_parallel(
-                    min(self.num_devices, op.outputs[0].shape[0]), nd)
-            return pc
+        # one shared per-op plan (config, padded dims, times, sync cost) —
+        # the same values the native path marshals
+        plans = {op.name: self._op_plan(op, strategies) for op in layers}
 
         # 1) forward + backward tasks per partition
         for op in layers:
-            pc = cfg_for(op)
-            dims = pc.dims
+            pc, dims, ft, bt, _sync = plans[op.name]
             out = op.outputs[0]
-            if len(dims) != out.num_dims:
-                dims = tuple(dims[: out.num_dims]) + \
-                    (1,) * max(0, out.num_dims - len(dims))
-            ft = self._op_time(op, dims, backward=False)
-            bt = self._op_time(op, dims, backward=True)
             if not np.isfinite(ft) or not np.isfinite(bt):
                 return float("inf")
             coords = _part_coords(dims)
@@ -223,16 +334,15 @@ class Simulator:
                 tf_.add_next(tb_)
 
         # 4) weight sync (update) tasks: ring allreduce per parameter over
-        # its replica set (reference simulator.cc:327-408)
+        # its replica set (reference simulator.cc:327-408); cost computed
+        # once in _op_plan, shared with the native path
         update_total = 0.0
         for op in layers:
-            pc = cfg_for(op)
             if not op.weights:
                 continue
-            replicas = pc.num_parts  # DP replicas share the weight
-            wbytes = sum(w.volume * 4 for w in op.weights if w.trainable)
-            t_sync = allreduce_time(wbytes, min(replicas, self.num_devices),
-                                    self.spec)
+            t_sync = plans[op.name][4]
+            if t_sync <= 0.0:
+                continue
             if overlap_backward_update:
                 ut = SimTask(t_sync, 0, "update")
                 tasks.append(ut)
