@@ -1,0 +1,28 @@
+"""CANDLE Uno app (reference examples/cpp/candle_uno/candle_uno.cc):
+multi-tower drug-response regression with op-form MSE loss."""
+
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.models.candle_uno import (DEFAULT_FEATURE_SHAPES,
+                                            DEFAULT_INPUT_FEATURES,
+                                            build_candle_uno)
+
+
+def top_level_task():
+    cfg = ff.get_default_config()
+    model, inputs, preds = build_candle_uno(cfg)
+    # reference: SGD lr=0.001 (candle_uno.cc:134)
+    model.compile(ff.SGDOptimizer(lr=0.001), final_tensor=preds)
+    model.init_layers(seed=cfg.seed)
+    n = cfg.batch_size * 4
+    rng = np.random.default_rng(cfg.seed)
+    xs = [rng.standard_normal(
+        (n, DEFAULT_FEATURE_SHAPES[kind])).astype(np.float32)
+        for kind in DEFAULT_INPUT_FEATURES.values()]
+    y = rng.random((n, 1)).astype(np.float32)
+    model.fit(xs, y, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

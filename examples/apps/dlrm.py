@@ -1,0 +1,31 @@
+"""DLRM app (reference examples/cpp/DLRM/dlrm.cc).  Synthetic data by
+default; pass --hetero-style strategies via
+``flexflow-tpu-dlrm-strategy --hetero`` + ``-import file.pb`` to place
+embedding tables in host memory."""
+
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.models.dlrm import build_dlrm
+
+EMBEDDING_SIZE = (100000,) * 8
+
+
+def top_level_task():
+    cfg = ff.get_default_config()
+    model, inputs, preds = build_dlrm(
+        cfg, embedding_size=EMBEDDING_SIZE, sparse_feature_size=64,
+        mlp_bot=(13, 512, 64), mlp_top=(576, 512, 256, 1))
+    model.compile(ff.SGDOptimizer(lr=cfg.learning_rate), final_tensor=preds)
+    model.init_layers(seed=cfg.seed)
+    n = cfg.batch_size * 8
+    rng = np.random.default_rng(cfg.seed)
+    xs = [rng.integers(0, v, (n, 1)).astype(np.int32)
+          for v in EMBEDDING_SIZE]
+    xs.append(rng.standard_normal((n, 13)).astype(np.float32))
+    y = rng.random((n, 1)).astype(np.float32)
+    model.fit(xs, y, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,21 @@
+"""ResNet-50 app (reference examples/cpp/ResNet/resnet.cc)."""
+
+import flexflow_tpu as ff
+from flexflow_tpu.data import synthetic_dataset
+from flexflow_tpu.models.resnet import build_resnet50
+
+
+def top_level_task():
+    cfg = ff.get_default_config()
+    model, inp, logits = build_resnet50(cfg, num_classes=1000)
+    model.compile(ff.SGDOptimizer(lr=cfg.learning_rate),
+                  ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [ff.METRICS_ACCURACY], final_tensor=logits)
+    model.init_layers(seed=cfg.seed)
+    xs, y = synthetic_dataset(cfg.batch_size * 2, [inp.shape[1:]], (1,),
+                              num_classes=1000)
+    model.fit(xs[0], y, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

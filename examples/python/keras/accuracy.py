@@ -1,0 +1,3 @@
+"""Per-model accuracy bounds (reference examples/python/keras/accuracy.py)."""
+
+from flexflow_tpu.keras.callbacks import ModelAccuracy  # noqa: F401

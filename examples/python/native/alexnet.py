@@ -1,0 +1,23 @@
+"""AlexNet on synthetic data — the reference's default smoke workload
+(examples/cpp/AlexNet/alexnet.cc; python variant
+examples/python/native/alexnet.py).  Run: flexflow-tpu alexnet.py -e 1 -b 64"""
+
+import flexflow_tpu as ff
+from flexflow_tpu.data import synthetic_dataset
+from flexflow_tpu.models.alexnet import build_alexnet
+
+
+def top_level_task():
+    cfg = ff.get_default_config()
+    model, inp, logits = build_alexnet(cfg, num_classes=10)
+    model.compile(ff.SGDOptimizer(lr=0.001),
+                  ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [ff.METRICS_ACCURACY], final_tensor=logits)
+    model.init_layers(seed=cfg.seed)
+    xs, y = synthetic_dataset(cfg.batch_size * 4, [inp.shape[1:]], (1,),
+                              num_classes=10)
+    model.fit(xs[0], y, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

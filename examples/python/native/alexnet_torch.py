@@ -1,0 +1,58 @@
+"""AlexNet through the torch.nn shim (reference
+examples/python/native/alexnet_torch.py)."""
+
+import flexflow_tpu as ff
+from flexflow_tpu.data import synthetic_dataset
+from flexflow_tpu.torch import nn
+
+
+class AlexNet(nn.Module):
+    def __init__(self, config=None):
+        super().__init__(config)
+        self.conv2_1 = nn.Conv2d(3, 64, kernel_size=11, stride=4, padding=2)
+        self.relu_1 = nn.ReLU()
+        self.maxpool2d_1 = nn.MaxPool2d(kernel_size=3, stride=2)
+        self.conv2_2 = nn.Conv2d(64, 192, kernel_size=5, stride=1, padding=2)
+        self.relu_2 = nn.ReLU()
+        self.maxpool2d_2 = nn.MaxPool2d(kernel_size=3, stride=2)
+        self.conv2_3 = nn.Conv2d(192, 384, kernel_size=3, stride=1, padding=1)
+        self.relu_3 = nn.ReLU()
+        self.conv2_4 = nn.Conv2d(384, 256, kernel_size=3, stride=1, padding=1)
+        self.relu_4 = nn.ReLU()
+        self.conv2_5 = nn.Conv2d(256, 256, kernel_size=3, stride=1, padding=1)
+        self.relu_5 = nn.ReLU()
+        self.maxpool2d_3 = nn.MaxPool2d(kernel_size=3, stride=2)
+        self.flat = nn.Flatten()
+        self.linear_1 = nn.Linear(256 * 6 * 6, 4096)
+        self.relu_6 = nn.ReLU()
+        self.linear_2 = nn.Linear(4096, 4096)
+        self.relu_7 = nn.ReLU()
+        self.linear_3 = nn.Linear(4096, 10)
+        self.softmax = nn.Softmax()
+
+    def forward(self, x):
+        x = self.maxpool2d_1(self.relu_1(self.conv2_1(x)))
+        x = self.maxpool2d_2(self.relu_2(self.conv2_2(x)))
+        x = self.relu_3(self.conv2_3(x))
+        x = self.relu_4(self.conv2_4(x))
+        x = self.maxpool2d_3(self.relu_5(self.conv2_5(x)))
+        x = self.flat(x)
+        x = self.relu_6(self.linear_1(x))
+        x = self.relu_7(self.linear_2(x))
+        return self.softmax(self.linear_3(x))
+
+
+def top_level_task():
+    net = AlexNet()
+    cfg = net.ffconfig
+    out = net(net.create_input((cfg.batch_size, 3, 229, 229)))
+    net.compile(ff.SGDOptimizer(lr=0.001),
+                ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                [ff.METRICS_ACCURACY])
+    xs, y = synthetic_dataset(cfg.batch_size * 4, [(3, 229, 229)], (1,),
+                              num_classes=10)
+    net.fit(xs[0], y, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

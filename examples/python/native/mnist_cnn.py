@@ -1,0 +1,33 @@
+"""MNIST CNN through the native FFModel API (reference
+examples/python/native/mnist_cnn.py)."""
+
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.keras.datasets import mnist
+
+
+def top_level_task():
+    cfg = ff.get_default_config()
+    model = ff.FFModel(cfg)
+    x = model.create_tensor((cfg.batch_size, 1, 28, 28), name="input")
+    t = model.conv2d(x, 32, 3, 3, 1, 1, 1, 1, activation="relu")
+    t = model.conv2d(t, 64, 3, 3, 1, 1, 1, 1, activation="relu")
+    t = model.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = model.flat(t)
+    t = model.dense(t, 128, activation="relu")
+    t = model.dense(t, 10)
+    logits = t
+    model.softmax(t)
+    model.compile(ff.SGDOptimizer(lr=cfg.learning_rate),
+                  ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [ff.METRICS_ACCURACY], final_tensor=logits)
+    model.init_layers(seed=cfg.seed)
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 1, 28, 28).astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+    model.fit(x_train, y_train, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

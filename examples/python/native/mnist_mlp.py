@@ -1,0 +1,31 @@
+"""MNIST MLP through the native FFModel API (reference
+examples/python/native/mnist_mlp.py).  Run: flexflow-tpu mnist_mlp.py -e 5"""
+
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.keras.datasets import mnist
+
+
+def top_level_task():
+    cfg = ff.get_default_config()
+    model = ff.FFModel(cfg)
+    x = model.create_tensor((cfg.batch_size, 784), name="input")
+    t = model.dense(x, 512, activation="relu")
+    t = model.dense(t, 512, activation="relu")
+    t = model.dense(t, 10)
+    logits = t
+    model.softmax(t)
+    model.compile(ff.SGDOptimizer(lr=cfg.learning_rate),
+                  ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [ff.METRICS_ACCURACY], final_tensor=logits)
+    model.init_layers(seed=cfg.seed)
+
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 784).astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+    model.fit(x_train, y_train, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,34 @@
+"""Dump weights/activations for manual diffing (reference
+examples/python/native/print_layers.py via Parameter.get_weights /
+inline mapping, model.cu:319-370)."""
+
+import numpy as np
+
+import flexflow_tpu as ff
+
+
+def top_level_task():
+    cfg = ff.get_default_config()
+    model = ff.FFModel(cfg)
+    x = model.create_tensor((cfg.batch_size, 784), name="input")
+    t = model.dense(x, 64, activation="relu", name="dense1")
+    t = model.dense(t, 10, name="dense2")
+    model.compile(ff.SGDOptimizer(lr=0.01),
+                  ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [ff.METRICS_ACCURACY], final_tensor=t)
+    model.init_layers(seed=cfg.seed)
+    print(model.summary())
+    for p in model.parameters:
+        w = model.get_weights(p.name)
+        print(f"{p.name}: shape={w.shape} mean={w.mean():+.6f} "
+              f"std={w.std():.6f}")
+    rng = np.random.default_rng(0)
+    xb = rng.standard_normal((cfg.batch_size, 784)).astype(np.float32)
+    yb = rng.integers(0, 10, (cfg.batch_size, 1)).astype(np.int32)
+    model.set_batch(xb, yb)
+    logits = np.asarray(model.forward())
+    print("logits[0]:", np.array2string(logits[0], precision=4))
+
+
+if __name__ == "__main__":
+    top_level_task()

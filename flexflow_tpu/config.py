@@ -129,6 +129,11 @@ class FFConfig:
     mesh_shape: Optional[Dict[str, int]] = None  # explicit mesh override
     simulator_mode: str = "analytic"  # "analytic" | "measure"
     remat: bool = False  # jax.checkpoint the forward pass
+    # opt-in Pallas flash-attention kernel: wins at long sequence lengths
+    # where the O(s^2) score matrix stops fitting fused on-chip, but loses
+    # to XLA's fused dense attention at moderate s (measured: 2x slower at
+    # s=512 on v5e) — benchmark per workload before enabling
+    flash_attention: bool = False
 
     # resolved at FFModel construction
     strategies: Dict[str, ParallelConfig] = dataclasses.field(default_factory=dict)

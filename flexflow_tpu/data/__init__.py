@@ -1,1 +1,2 @@
-from .dataloader import DataLoader, SingleDataLoader, synthetic_dataset
+from .dataloader import (DataLoader, PrefetchLoader, SingleDataLoader,
+                         load_numpy_dataset, synthetic_dataset)

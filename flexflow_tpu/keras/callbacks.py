@@ -49,15 +49,18 @@ class LearningRateScheduler(Callback):
         self.schedule = schedule
 
     def on_epoch_begin(self, epoch, logs=None):
-        lr = self.schedule(epoch)
+        lr = float(self.schedule(epoch))
         opt = self.model.optimizer
-        if not hasattr(opt, "lr"):
+        if hasattr(opt, "lr"):
+            opt.lr = lr         # SGD
+        elif hasattr(opt, "alpha"):
+            opt.alpha = lr      # Adam stores its rate as alpha
+        else:
             raise ValueError('Optimizer must have a "lr" attribute.')
-        opt.lr = float(lr)
         # the jitted step closes over the optimizer object; re-trace with
         # the new hyperparameter
         self.model._build_step_fns()
-        print("set learning rate ", opt.lr)
+        print("set learning rate ", lr)
 
 
 class VerifyMetrics(Callback):

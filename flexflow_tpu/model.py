@@ -461,7 +461,8 @@ class FFModel:
 
         def forward_full(params, batch, rng, training):
             ctx = OpContext(training=training, rng=rng,
-                            compute_dtype=cfg.compute_dtype, mesh=self.mesh)
+                            compute_dtype=cfg.compute_dtype, mesh=self.mesh,
+                            flash_attention=cfg.flash_attention)
             inputs = {uid: x for uid, x in zip(input_uids, batch[:-1])}
             values = self._forward_values(params, inputs, ctx)
             return values[loss_uid], values[final_uid], ctx.updates

@@ -1,4 +1,5 @@
 from .alexnet import build_alexnet
+from .candle_uno import build_candle_uno
 from .dlrm import build_dlrm
 from .inception import build_inception_v3
 from .resnet import build_resnet50

@@ -61,6 +61,7 @@ class OpContext:
     rng: Optional[jax.Array] = None
     compute_dtype: str = "bfloat16"
     mesh: Optional[object] = None  # MachineMesh when compiled multi-chip
+    flash_attention: bool = False  # opt-in Pallas kernel (FFConfig)
     # functional state updates: ops write {param_name: new_value} here for
     # non-trainable state (batchnorm running stats); the train step returns
     # them as part of the new params pytree

@@ -34,6 +34,32 @@ from .common import cast_compute
 NEG_INF = -1e30  # finite mask value: keeps online-softmax exp() NaN-free
 
 
+def _flash_attention_ok(q, k, training_dropout: bool) -> bool:
+    """The Pallas TPU flash kernel applies when running on TPU with
+    kernel-friendly shapes and no attention-prob dropout (the kernel never
+    materializes probabilities)."""
+    if training_dropout or jax.default_backend() != "tpu":
+        return False
+    sq, sk, d = q.shape[1], k.shape[1], q.shape[3]
+    return (sq % 128 == 0 and sk % 128 == 0 and d % 64 == 0
+            and q.dtype in (jnp.float32, jnp.bfloat16))
+
+
+def _flash_attention(q, k, v, causal: bool, scale: float):
+    """Pallas TPU flash attention (jax.experimental.pallas.ops.tpu):
+    blockwise online softmax on-chip — the VMEM-resident fused kernel the
+    pallas_guide prescribes for the attention hot op.  Layout adapters:
+    ours is (n,s,h,d), the kernel wants (n,h,s,d)."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import \
+        flash_attention as _fa
+
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    out = _fa(qt, kt, vt, causal=causal, sm_scale=scale)
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
 def _dense_attention(q, k, v, causal: bool, scale: float,
                      dropout_rate: float, rng):
     """(n,sq,h,d),(n,sk,h,d),(n,sk,h,d) -> (n,sq,h,d); f32 softmax."""
@@ -209,6 +235,9 @@ class MultiHeadAttention(Op):
         if self._wants_ring(ctx):
             attn = ring_attention(q, k, v, ctx.mesh, self.causal, scale,
                                   self.dropout if ctx.training else 0.0, rng)
+        elif ctx.flash_attention and _flash_attention_ok(q, k,
+                                                         rng is not None):
+            attn = _flash_attention(q, k, v, self.causal, scale)
         else:
             attn = _dense_attention(q, k, v, self.causal, scale,
                                     self.dropout if ctx.training else 0.0,

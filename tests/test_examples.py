@@ -1,0 +1,54 @@
+"""candle_uno workload + runnable examples (reference §2.11 example apps
+double as integration tests; SURVEY §4)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.models.candle_uno import build_candle_uno
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_candle_uno_trains():
+    """Shrunk feature shapes, same graph shape as candle_uno.cc."""
+    shapes = {"dose": 1, "cell.rnaseq": 30, "drug.descriptors": 40,
+              "drug.fingerprints": 20}
+    feats = {"dose1": "dose", "dose2": "dose", "cell.rnaseq": "cell.rnaseq",
+             "drug1.descriptors": "drug.descriptors",
+             "drug1.fingerprints": "drug.fingerprints"}
+    cfg = ff.FFConfig(batch_size=16, compute_dtype="float32")
+    model, inputs, preds = build_candle_uno(
+        cfg, dense_layers=(32, 32), dense_feature_layers=(16, 16),
+        feature_shapes=shapes, input_features=feats)
+    model.compile(ff.SGDOptimizer(lr=0.01), final_tensor=preds)
+    model.init_layers(seed=0)
+    assert model.loss_type == "mean_squared_error_avg_reduce"
+    # dose towers pass through raw (width-1 features are not encoded),
+    # multi-dim features get towers: concat width = 1 + 1 + 3*16
+    assert model.get_parameter_by_name("head/kernel") is not None
+    rng = np.random.default_rng(0)
+    xs = [rng.standard_normal((16, shapes[k])).astype(np.float32)
+          for k in feats.values()]
+    y = rng.random((16, 1)).astype(np.float32)
+    losses = [float(model.train_batch(*xs, y)) for _ in range(5)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+@pytest.mark.parametrize("script", [
+    "examples/python/native/mnist_mlp.py",
+    "examples/python/native/print_layers.py",
+])
+def test_example_scripts_run(script):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-m", "flexflow_tpu.cli", os.path.join(REPO, script),
+         "-b", "32", "-e", "1"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]

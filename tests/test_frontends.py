@@ -133,6 +133,34 @@ def test_lr_scheduler_and_early_stop_callbacks():
     assert len(lrs) < 20  # early-stopped
 
 
+def test_lr_scheduler_works_with_adam():
+    """Adam stores its rate as alpha; the scheduler must still apply."""
+    from flexflow_tpu.keras import LearningRateScheduler
+    x, y = _learnable_data(n=64)
+    cfg = ff.FFConfig(batch_size=32, compute_dtype="float32")
+    model = Sequential([Dense(4, input_shape=(12,)), Activation("softmax")])
+    model.compile(keras.Adam(learning_rate=0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], config=cfg)
+    model.fit(x, y, epochs=2, verbose=0,
+              callbacks=[LearningRateScheduler(lambda e: 0.01 / (e + 1))])
+    assert model.ffmodel.optimizer.alpha == pytest.approx(0.005)
+
+
+def test_load_numpy_dataset_keras_layout(tmp_path):
+    """A keras-style archive must return the TRAIN split, never pair
+    x_test with y_train."""
+    import os
+    from flexflow_tpu.data import load_numpy_dataset
+    path = os.path.join(tmp_path, "mnist.npz")
+    np.savez(path,
+             x_train=np.zeros((60, 4)), y_train=np.ones((60,)),
+             x_test=np.zeros((10, 4)), y_test=np.zeros((10,)))
+    xs, y = load_numpy_dataset(path)
+    assert len(xs) == 1 and xs[0].shape == (60, 4)
+    assert y.shape == (60,) and y[0] == 1.0
+
+
 def test_shared_layer_reuse_raises():
     d = Dense(4)
     a, b = Input((8,)), Input((8,))

@@ -143,6 +143,24 @@ def test_ring_attention_dropout_trains():
     assert losses[-1] < losses[0]
 
 
+def test_flash_attention_flag_degrades_off_tpu():
+    """config.flash_attention is an opt-in TPU kernel; on the CPU test
+    backend it must silently fall back to the dense path and still train."""
+    cfg = ff.FFConfig(batch_size=8, compute_dtype="float32",
+                      flash_attention=True)
+    model, tokens, logits = build_transformer(
+        cfg, num_layers=1, d_model=64, num_heads=1, d_ff=64, seq_len=128,
+        vocab_size=50, num_classes=4)
+    model.compile(ff.SGDOptimizer(lr=0.05),
+                  ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY, [],
+                  final_tensor=logits)
+    model.init_layers(seed=0)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 50, (8, 128)).astype(np.int32)
+    y = rng.integers(0, 4, (8, 1)).astype(np.int32)
+    assert np.isfinite(float(model.train_batch(x, y)))
+
+
 def test_searched_transformer_strategy_executes():
     """MCMC search over the transformer graph returns executable strategies
     (extends the round-1 legality property to the attention op)."""
