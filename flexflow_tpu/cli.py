@@ -35,6 +35,11 @@ def main(argv=None) -> None:
     cfg = FFConfig.parse_args(flags)
     import flexflow_tpu
     flexflow_tpu.set_default_config(cfg)
+    # bring up the multi-host runtime when this is one process of a slice
+    # (single-process runs are a no-op) — the reference's GASNet bring-up
+    # happens likewise before the top-level task runs
+    from flexflow_tpu.parallel import initialize_distributed
+    initialize_distributed()
     # the script sees the remaining argv like any __main__
     sys.argv = [script] + flags
     runpy.run_path(script, run_name="__main__")

@@ -134,6 +134,10 @@ class FFConfig:
     # to XLA's fused dense attention at moderate s (measured: 2x slower at
     # s=512 on v5e) — benchmark per workload before enabling
     flash_attention: bool = False
+    # when set, fit() wraps the epoch loop in a jax.profiler trace whose
+    # dump lands here (TensorBoard-loadable) — the XLA-level complement of
+    # --profiling's per-op table
+    trace_dir: str = ""
 
     # resolved at FFModel construction
     strategies: Dict[str, ParallelConfig] = dataclasses.field(default_factory=dict)

@@ -59,7 +59,9 @@ def load_numpy_dataset(path: str):
                                     else None)
         xs = [f[k] for k in keys
               if k.startswith("x") and not k.startswith("x_test")]
-        ys = [f[k] for k in keys if k.startswith("y") or k == "label"]
+        ys = [f[k] for k in keys
+              if (k.startswith("y") and not k.startswith("y_test"))
+              or k == "label"]
         if not xs:  # positional fallback: first n-1 arrays are inputs
             arrays = [f[k] for k in keys]
             xs, ys = arrays[:-1], arrays[-1:]

@@ -596,6 +596,56 @@ class FFModel:
             val = jax.device_put(val, cur.sharding)
         self._params[key] = val
 
+    # ------------------------------------------------------------------
+    # checkpoint / resume (beyond the reference: it persists nothing but
+    # strategy files — SURVEY §5 "no model checkpointing")
+    # ------------------------------------------------------------------
+    def save_checkpoint(self, path: str) -> None:
+        """Write params + optimizer state + step to one ``.npz``."""
+        flat: Dict[str, np.ndarray] = {}
+        for k, v in self._params.items():
+            flat[f"param:{k}"] = np.asarray(v)
+        leaves, treedef = jax.tree_util.tree_flatten(self._opt_state)
+        for i, leaf in enumerate(leaves):
+            flat[f"opt:{i}"] = np.asarray(leaf)
+        flat["meta:step"] = np.asarray(self._step, np.int64)
+        np.savez(path, **flat)
+
+    def load_checkpoint(self, path: str) -> None:
+        """Restore a checkpoint written by :meth:`save_checkpoint`,
+        re-applying each parameter's sharding (incl. host placement).
+        Validates the full key set BEFORE mutating any state, so a graph or
+        optimizer mismatch fails cleanly instead of half-restoring."""
+        assert self._compiled, "call compile() + init_layers() first"
+        with np.load(path) as f:
+            ckpt_params = {k[len("param:"):] for k in f.files
+                           if k.startswith("param:")}
+            cur_params = set(self._params)
+            if ckpt_params != cur_params:
+                missing = sorted(cur_params - ckpt_params)
+                extra = sorted(ckpt_params - cur_params)
+                raise ValueError(
+                    f"checkpoint does not match this model: "
+                    f"missing params {missing[:5]}, unexpected {extra[:5]}")
+            leaves, treedef = jax.tree_util.tree_flatten(self._opt_state)
+            n_opt = sum(1 for k in f.files if k.startswith("opt:"))
+            if n_opt != len(leaves):
+                raise ValueError(
+                    f"optimizer state mismatch: checkpoint has {n_opt} "
+                    f"slots, this optimizer has {len(leaves)} (was it saved "
+                    f"with a different optimizer?)")
+            for name in ckpt_params:
+                cur = self._params[name]
+                val = jnp.asarray(f[f"param:{name}"], cur.dtype)
+                self._params[name] = jax.device_put(val, cur.sharding)
+            new_leaves = []
+            for i, leaf in enumerate(leaves):
+                arr = jnp.asarray(f[f"opt:{i}"], leaf.dtype)
+                new_leaves.append(jax.device_put(arr, leaf.sharding))
+            self._opt_state = jax.tree_util.tree_unflatten(treedef,
+                                                           new_leaves)
+            self._step = int(f["meta:step"])
+
     def _resolve(self, name: str) -> str:
         if name in self._params:
             return name
@@ -702,35 +752,41 @@ class FFModel:
             # conv_2d.cu:446-471 cudaEvent prints), measured in isolation
             from .profiling import profile_model
             profile_model(self)
+        import contextlib
+        tracer = (jax.profiler.trace(cfg.trace_dir) if cfg.trace_dir
+                  else contextlib.nullcontext())
         from .data.dataloader import PrefetchLoader
         loader = PrefetchLoader(self, xs, y, batch_size=bs)
         t_start = time.time()
         total_samples = 0
-        for epoch in range(epochs):
-            for cb in callbacks:
-                cb.on_epoch_begin(epoch)
-            self.perf_metrics = metrics_mod.PerfMetrics()
-            epoch_sums = []
-            for batch in loader:
-                self._params, self._opt_state, loss, sums = self._train_step(
-                    self._params, self._opt_state, batch, self._step)
-                if self._host_shardings:
-                    self._repin_host()
-                self._step += 1
-                total_samples += bs
-                # keep metric sums on device; fetching here would fence the
-                # async dispatch pipeline every step
-                epoch_sums.append(sums)
-            for sums in jax.device_get(epoch_sums):
-                self.perf_metrics.update(sums)
-            if verbose:
-                print(f"epoch {epoch}: "
-                      f"{self.perf_metrics.report(self.metrics or [self.loss_type])}")
-            for cb in callbacks:
-                cb.on_epoch_end(epoch, self.perf_metrics)
-            if any(getattr(cb, "stop_training", False) for cb in callbacks):
-                break
-        jax.block_until_ready(self._params)
+        with tracer:
+            for epoch in range(epochs):
+                for cb in callbacks:
+                    cb.on_epoch_begin(epoch)
+                self.perf_metrics = metrics_mod.PerfMetrics()
+                epoch_sums = []
+                for batch in loader:
+                    self._params, self._opt_state, loss, sums = \
+                        self._train_step(self._params, self._opt_state,
+                                         batch, self._step)
+                    if self._host_shardings:
+                        self._repin_host()
+                    self._step += 1
+                    total_samples += bs
+                    # keep metric sums on device; fetching here would fence
+                    # the async dispatch pipeline every step
+                    epoch_sums.append(sums)
+                for sums in jax.device_get(epoch_sums):
+                    self.perf_metrics.update(sums)
+                if verbose:
+                    print(f"epoch {epoch}: "
+                          f"{self.perf_metrics.report(self.metrics or [self.loss_type])}")
+                for cb in callbacks:
+                    cb.on_epoch_end(epoch, self.perf_metrics)
+                if any(getattr(cb, "stop_training", False)
+                       for cb in callbacks):
+                    break
+            jax.block_until_ready(self._params)
         elapsed = time.time() - t_start
         if verbose and elapsed > 0:
             # reference alexnet.cc:129-130 throughput line

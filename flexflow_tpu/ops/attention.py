@@ -41,7 +41,10 @@ def _flash_attention_ok(q, k, training_dropout: bool) -> bool:
     if training_dropout or jax.default_backend() != "tpu":
         return False
     sq, sk, d = q.shape[1], k.shape[1], q.shape[3]
-    return (sq % 128 == 0 and sk % 128 == 0 and d % 64 == 0
+    # the kernel truncates head_dim < 128 to a lane block; >= 128 must be a
+    # multiple of its 128 MIN_BLOCK_SIZE
+    return (sq % 128 == 0 and sk % 128 == 0
+            and (d < 128 or d % 128 == 0)
             and q.dtype in (jnp.float32, jnp.bfloat16))
 
 

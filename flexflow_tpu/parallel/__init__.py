@@ -1,2 +1,3 @@
+from .distributed import initialize_distributed, process_info
 from .mesh import AXES, MachineMesh, dim_axis_names
 from .sharding import batch_spec, output_spec, param_spec

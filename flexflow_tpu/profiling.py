@@ -43,11 +43,15 @@ def _init_params(op: Op, seed: int = 0) -> Dict[str, jax.Array]:
 
 
 def profile_op(op: Op, compute_dtype: str = "bfloat16", warmup: int = 2,
-               iters: int = 5) -> Dict[str, float]:
+               iters: int = 5, flash_attention: bool = False
+               ) -> Dict[str, float]:
     """(fwd_ms, bwd_ms) for one op, timed in isolation (reference
-    measure_compute_time contract: returns per-config latency)."""
+    measure_compute_time contract: returns per-config latency).  The ctx
+    mirrors the run's kernel choices (flash_attention) so the numbers match
+    what fit() actually executes."""
     ctx = OpContext(training=True, rng=jax.random.PRNGKey(0),
-                    compute_dtype=compute_dtype)
+                    compute_dtype=compute_dtype,
+                    flash_attention=flash_attention)
     params = _init_params(op)
     inputs = _example_inputs(op)
 
@@ -94,7 +98,8 @@ def profile_model(model, file=None) -> List[Dict[str, float]]:
     print(f"{'op':30s} {'type':14s} {'fwd(ms)':>9s} {'bwd(ms)':>9s}",
           file=file)
     for op in model.layers:
-        r = profile_op(op, model.config.compute_dtype)
+        r = profile_op(op, model.config.compute_dtype,
+                       flash_attention=model.config.flash_attention)
         rows.append({"name": op.name, **r})
         print(f"{op.name:30s} {op.op_type.value:14s} "
               f"{r['fwd_ms']:9.3f} {r['bwd_ms']:9.3f}", file=file)

@@ -111,12 +111,13 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
            alpha: float = 0.05, seed: int = 0,
            spec: DeviceSpec = DEFAULT_SPEC, measure: bool = False,
            overlap_backward_update: bool = False,
-           verbose: bool = False
+           verbose: bool = False, flash_attention: bool = False
            ) -> Tuple[Dict[str, ParallelConfig], MeshShape, float]:
     """Run the annealing loop; returns (best strategies, best mesh
     factorization, best simulated time)."""
     rng = random.Random(seed)
-    sim = Simulator(spec=spec, num_devices=num_devices, measure=measure)
+    sim = Simulator(spec=spec, num_devices=num_devices, measure=measure,
+                    flash_attention=flash_attention)
     meshes = candidate_meshes(num_devices)
 
     def dp_mesh() -> MeshShape:
@@ -186,7 +187,8 @@ def optimize_strategies(model, cfg: FFConfig) -> Dict[str, ParallelConfig]:
         model.layers, ndev, budget=cfg.search_budget,
         alpha=cfg.search_alpha, seed=cfg.seed,
         measure=(cfg.simulator_mode == "measure"),
-        overlap_backward_update=cfg.search_overlap_backward_update)
+        overlap_backward_update=cfg.search_overlap_backward_update,
+        flash_attention=cfg.flash_attention)
     print(f"[search] best simulated iteration time: {best_time * 1e3:.3f} ms "
           f"on {ndev} devices, mesh "
           f"{ {a: s for a, s in best_mesh.items() if s > 1} }")

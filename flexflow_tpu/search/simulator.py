@@ -76,12 +76,13 @@ class Simulator:
     def __init__(self, spec: DeviceSpec = DEFAULT_SPEC,
                  num_devices: int = 1, devices_per_slice: int = 0,
                  measure: bool = False, dtype_bytes: int = 2,
-                 use_native: bool = True):
+                 use_native: bool = True, flash_attention: bool = False):
         self.spec = spec
         self.num_devices = num_devices
         self.devices_per_slice = devices_per_slice or num_devices
         self.measure = measure
         self.dtype_bytes = dtype_bytes
+        self.flash_attention = flash_attention  # measure the run's kernels
         self._measure_cache: Dict[Tuple, float] = {}
         self._native = None
         if use_native:
@@ -114,7 +115,8 @@ class Simulator:
                           for t in op.inputs]
         except AssertionError:
             return float("inf")  # indivisible -> invalid config
-        ctx = OpContext(training=True, rng=jax.random.PRNGKey(0))
+        ctx = OpContext(training=True, rng=jax.random.PRNGKey(0),
+                        flash_attention=self.flash_attention)
         params = {}
         for w in op.weights:
             params[w.name] = jnp.zeros(w.shape, jnp.float32)
