@@ -37,9 +37,12 @@ def main(argv=None) -> None:
     flexflow_tpu.set_default_config(cfg)
     # bring up the multi-host runtime when this is one process of a slice
     # (single-process runs are a no-op) — the reference's GASNet bring-up
-    # happens likewise before the top-level task runs
+    # happens likewise before the top-level task runs.  --nodes > 1 makes
+    # the multi-host requirement explicit: failing to form the world is an
+    # error, not N disconnected replicas.
     from flexflow_tpu.parallel import initialize_distributed
-    initialize_distributed()
+    initialize_distributed(
+        num_processes=cfg.num_nodes if cfg.num_nodes > 1 else None)
     # the script sees the remaining argv like any __main__
     sys.argv = [script] + flags
     runpy.run_path(script, run_name="__main__")

@@ -139,6 +139,18 @@ class FFModel:
         self._register(op)
         return op.outputs[0], op.outputs[1], op.outputs[2]
 
+    def pipeline_transformer_block(self, input_tensor, num_stages, num_heads,
+                                   d_ff, num_microbatches=None,
+                                   name=None) -> Tensor:
+        """A stack of identical encoder blocks run as a GPipe collective
+        pipeline over the 'p' mesh axis (beyond the reference — SURVEY
+        §2.15: FlexFlow has no stage pipeline)."""
+        from .ops.pipeline import PipelineTransformerBlock
+        op = PipelineTransformerBlock(
+            self._uname("pipeline_block", name), input_tensor, num_stages,
+            num_heads, d_ff, num_microbatches)
+        return self._register(op).outputs[0]
+
     def multihead_attention(self, query, key=None, value=None, embed_dim=None,
                             num_heads=8, kdim=0, vdim=0, dropout=0.0,
                             bias=True, causal=False, kernel_initializer=None,
@@ -600,16 +612,30 @@ class FFModel:
     # checkpoint / resume (beyond the reference: it persists nothing but
     # strategy files — SURVEY §5 "no model checkpointing")
     # ------------------------------------------------------------------
+    @staticmethod
+    def _gather_host(v) -> np.ndarray:
+        """Fetch an array to host numpy, allgathering across processes for
+        multi-host shardings (np.asarray alone raises on arrays that are
+        not fully addressable)."""
+        if jax.process_count() > 1 and not v.is_fully_addressable:
+            from jax.experimental import multihost_utils
+            return np.asarray(multihost_utils.process_allgather(v,
+                                                               tiled=True))
+        return np.asarray(v)
+
     def save_checkpoint(self, path: str) -> None:
-        """Write params + optimizer state + step to one ``.npz``."""
+        """Write params + optimizer state + step to one ``.npz``.  In
+        multi-host runs every process participates in the gather but only
+        process 0 writes the file."""
         flat: Dict[str, np.ndarray] = {}
         for k, v in self._params.items():
-            flat[f"param:{k}"] = np.asarray(v)
+            flat[f"param:{k}"] = self._gather_host(v)
         leaves, treedef = jax.tree_util.tree_flatten(self._opt_state)
         for i, leaf in enumerate(leaves):
-            flat[f"opt:{i}"] = np.asarray(leaf)
+            flat[f"opt:{i}"] = self._gather_host(leaf)
         flat["meta:step"] = np.asarray(self._step, np.int64)
-        np.savez(path, **flat)
+        if jax.process_index() == 0:
+            np.savez(path, **flat)
 
     def load_checkpoint(self, path: str) -> None:
         """Restore a checkpoint written by :meth:`save_checkpoint`,
@@ -627,6 +653,14 @@ class FFModel:
                 raise ValueError(
                     f"checkpoint does not match this model: "
                     f"missing params {missing[:5]}, unexpected {extra[:5]}")
+            bad_shapes = [
+                (n, f[f"param:{n}"].shape, tuple(self._params[n].shape))
+                for n in sorted(ckpt_params)
+                if f[f"param:{n}"].shape != tuple(self._params[n].shape)]
+            if bad_shapes:
+                raise ValueError(
+                    f"checkpoint does not match this model: shape "
+                    f"mismatches {bad_shapes[:5]}")
             leaves, treedef = jax.tree_util.tree_flatten(self._opt_state)
             n_opt = sum(1 for k in f.files if k.startswith("opt:"))
             if n_opt != len(leaves):
@@ -634,6 +668,11 @@ class FFModel:
                     f"optimizer state mismatch: checkpoint has {n_opt} "
                     f"slots, this optimizer has {len(leaves)} (was it saved "
                     f"with a different optimizer?)")
+            for i, leaf in enumerate(leaves):
+                if f[f"opt:{i}"].shape != tuple(leaf.shape):
+                    raise ValueError(
+                        f"optimizer state mismatch: slot {i} shape "
+                        f"{f[f'opt:{i}'].shape} != {tuple(leaf.shape)}")
             for name in ckpt_params:
                 cur = self._params[name]
                 val = jnp.asarray(f[f"param:{name}"], cur.dtype)

@@ -50,6 +50,7 @@ class OpType(enum.Enum):
     MSELOSS = "mse_loss"
     ATTENTION = "attention"
     LSTM = "lstm"
+    PIPELINE = "pipeline"
     INPUT = "input"
 
 

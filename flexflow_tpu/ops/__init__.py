@@ -3,6 +3,7 @@ from .conv import Conv2D, Pool2D
 from .elementwise import ElementBinary, ElementUnary
 from .linear import Embedding, Linear
 from .norm import BatchNorm, LayerNorm, RMSNorm
+from .pipeline import PipelineTransformerBlock
 from .rnn import LSTM
 from .tensor_ops import (Concat, Dropout, Flat, Reshape, Softmax, Split,
                          Transpose)

@@ -1,0 +1,156 @@
+"""PipelineTransformerBlock — a stack of identical transformer encoder
+blocks executed as a GPipe collective pipeline over the ``p`` mesh axis
+(parallel/pipeline.py).
+
+Weights for all stages are stacked on a leading stage dim and sharded over
+``p`` (one stage per rank), so each chip holds only its own stage's
+parameters — the memory scaling pipeline parallelism exists for.  Off the
+pipeline mesh (p == 1 / single device) the same stacked weights run as a
+``lax.scan`` over stages, so numerics are identical by construction and
+tested to match.
+
+This is capability BEYOND the reference: FlexFlow has no stage pipeline
+(SURVEY §2.15 — per-op device placement + Legion async only).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..initializers import ConstantInitializer, GlorotUniform, ZeroInitializer
+from ..op import Op, OpContext, OpType
+from ..parallel.pipeline import pipeline_apply
+from .common import cast_compute
+
+
+class _StackedInit:
+    """Stacks a base initializer over per-stage keys, so stage i of the
+    pipeline initializes exactly like an unstacked block with key_i."""
+
+    def __init__(self, base, stages: int):
+        self.base, self.stages = base, stages
+
+    def __call__(self, key, shape, dtype):
+        keys = jax.random.split(key, self.stages)
+        return jnp.stack([self.base(k, shape[1:], dtype) for k in keys])
+
+
+class PipelineTransformerBlock(Op):
+    op_type = OpType.PIPELINE
+
+    def __init__(self, name, input_tensor, num_stages, num_heads,
+                 d_ff, num_microbatches=None, eps=1e-5,
+                 kernel_initializer=None):
+        super().__init__(name, [input_tensor])
+        n, s, d = input_tensor.shape
+        assert d % num_heads == 0, (d, num_heads)
+        self.num_stages = int(num_stages)
+        self.num_heads = num_heads
+        self.head_dim = d // num_heads
+        self.d_ff, self.eps = d_ff, eps
+        self.num_microbatches = num_microbatches
+        self._add_output((n, s, d), input_tensor.dtype)
+        S = self.num_stages
+        base = kernel_initializer or GlorotUniform()
+        ones = ConstantInitializer(1.0)
+        zeros = ZeroInitializer()
+
+        def w(shape, init, nm):
+            p = self._add_weight((S,) + shape, _StackedInit(init, S), nm,
+                                 sharded_dim=0)
+            p.shard_axis = "p"
+            return p
+
+        self.w_q = w((d, d), base, "wq")
+        self.w_k = w((d, d), base, "wk")
+        self.w_v = w((d, d), base, "wv")
+        self.w_o = w((d, d), base, "wo")
+        self.w_ab = w((d,), zeros, "attn_bias")
+        self.w_ln1s = w((d,), ones, "ln1_scale")
+        self.w_ln1b = w((d,), zeros, "ln1_bias")
+        self.w_up = w((d_ff, d), base, "ffn_up")
+        self.w_upb = w((d_ff,), zeros, "ffn_up_bias")
+        self.w_dn = w((d, d_ff), base, "ffn_down")
+        self.w_dnb = w((d,), zeros, "ffn_down_bias")
+        self.w_ln2s = w((d,), ones, "ln2_scale")
+        self.w_ln2b = w((d,), zeros, "ln2_bias")
+
+    def _stage_fn(self, ctx: OpContext):
+        h, hd = self.num_heads, self.head_dim
+        scale = 1.0 / math.sqrt(hd)
+        eps = self.eps
+
+        def ln(x, s, b):
+            xf = x.astype(jnp.float32)
+            mu = xf.mean(-1, keepdims=True)
+            var = xf.var(-1, keepdims=True)
+            return (xf - mu) * jax.lax.rsqrt(var + eps) * s + b
+
+        def block(p, x):
+            xc = cast_compute(x, ctx)
+            n, s, d = xc.shape
+
+            def proj(w):
+                y = jnp.einsum("nsi,oi->nso", xc, cast_compute(p[w], ctx),
+                               preferred_element_type=jnp.float32)
+                return cast_compute(y, ctx).reshape(n, s, h, hd)
+
+            q, k, v = proj("wq"), proj("wk"), proj("wv")
+            scores = jnp.einsum("nqhd,nkhd->nhqk", q, k,
+                                preferred_element_type=jnp.float32) * scale
+            probs = jax.nn.softmax(scores, axis=-1)
+            attn = jnp.einsum("nhqk,nkhd->nqhd", probs.astype(v.dtype), v,
+                              preferred_element_type=jnp.float32)
+            attn = cast_compute(attn, ctx).reshape(n, s, d)
+            attn = jnp.einsum("nsi,oi->nso", attn,
+                              cast_compute(p["wo"], ctx),
+                              preferred_element_type=jnp.float32)
+            attn = attn + p["attn_bias"].astype(attn.dtype)
+            t = ln(x.astype(jnp.float32) + attn.astype(jnp.float32),
+                   p["ln1_scale"], p["ln1_bias"])
+            tc = cast_compute(t, ctx)
+            up = jnp.einsum("nsi,oi->nso", tc, cast_compute(p["ffn_up"], ctx),
+                            preferred_element_type=jnp.float32)
+            up = jax.nn.gelu(up + p["ffn_up_bias"].astype(up.dtype))
+            dn = jnp.einsum("nsi,oi->nso", cast_compute(up, ctx),
+                            cast_compute(p["ffn_down"], ctx),
+                            preferred_element_type=jnp.float32)
+            dn = dn + p["ffn_down_bias"].astype(dn.dtype)
+            out = ln(t + dn.astype(jnp.float32), p["ln2_scale"],
+                     p["ln2_bias"])
+            return out.astype(x.dtype)
+
+        return block
+
+    def forward(self, params, inputs, ctx: OpContext):
+        x = inputs[0].astype(jnp.float32)
+        names = {"wq": self.w_q, "wk": self.w_k, "wv": self.w_v,
+                 "wo": self.w_o, "attn_bias": self.w_ab,
+                 "ln1_scale": self.w_ln1s, "ln1_bias": self.w_ln1b,
+                 "ffn_up": self.w_up, "ffn_up_bias": self.w_upb,
+                 "ffn_down": self.w_dn, "ffn_down_bias": self.w_dnb,
+                 "ln2_scale": self.w_ln2s, "ln2_bias": self.w_ln2b}
+        stacked = {k: params[p.name] for k, p in names.items()}
+        block = self._stage_fn(ctx)
+        if ctx.mesh is not None and ctx.mesh.axis_size("p") > 1:
+            y = pipeline_apply(block, stacked, x, ctx.mesh,
+                               self.num_microbatches)
+        else:
+            def body(hh, p):
+                return block(p, hh), None
+
+            y, _ = jax.lax.scan(body, x, stacked)
+        return [cast_compute(y, ctx)]
+
+    def parallel_dims(self):
+        # DP over samples composes with the pipeline; s/c stay whole here
+        return (True, False, False)
+
+    def flops(self):
+        n, s, d = self.outputs[0].shape
+        per_block = (4 * 2 * n * s * d * d + 2 * 2 * n * s * s * d
+                     + 2 * 2 * n * s * d * self.d_ff)
+        return self.num_stages * per_block

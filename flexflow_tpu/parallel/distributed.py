@@ -44,6 +44,14 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     if (coordinator_address is None and num_processes is None
             and not multi_host_tpu):
         return False
+    if (num_processes is not None and num_processes > 1
+            and coordinator_address is None and not multi_host_tpu):
+        # multi-host explicitly requested but unreachable: fail loudly
+        # rather than training N disconnected replicas
+        raise ValueError(
+            f"{num_processes} processes requested but no coordinator is "
+            f"configured — set JAX_COORDINATOR_ADDRESS (+ JAX_PROCESS_ID) "
+            f"on every host, or run on a TPU slice with worker metadata")
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)

@@ -31,11 +31,15 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-AXES: Tuple[str, ...] = ("n", "c", "h", "w", "s")
+AXES: Tuple[str, ...] = ("n", "c", "h", "w", "s", "p")
 
-# readable aliases accepted in mesh_shape configs
+# readable aliases accepted in mesh_shape configs.  "p" is the pipeline-
+# stage axis: unlike the others it maps to no logical tensor dim
+# (dim_axis_names never yields it) — stages of a PipelineBlock shard their
+# stacked weights over it and activations ride a ppermute ring.
 _ALIAS = {"data": "n", "batch": "n", "model": "c", "tensor": "c",
-          "seq": "s", "sequence": "s", "expert": "c", "pipeline": "h"}
+          "seq": "s", "sequence": "s", "expert": "c", "pipeline": "p",
+          "stage": "p"}
 
 
 def prime_factors(n: int) -> Tuple[int, ...]:

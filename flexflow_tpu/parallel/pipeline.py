@@ -1,0 +1,112 @@
+"""Pipeline parallelism — GPipe-style collective pipeline over the ``p``
+mesh axis.
+
+The reference has NO stage-based pipeline (SURVEY §2.15: per-op
+``device_ids`` + Legion async task issue give only *implicit* overlap; the
+NMT engine chunks timesteps the same way).  This module goes beyond it with
+an explicit TPU-native pipeline: homogeneous stages hold their stacked
+weights sharded over ``p`` (one stage per p-rank), microbatches stream
+through a ``lax.scan`` of ticks, activations hop stage-to-stage with
+``lax.ppermute``, and the final stage's emissions are psum-gathered.
+Gradients fall out of autodiff through the scan (ppermute and psum are
+linear), giving synchronous GPipe semantics: all microbatch gradients
+accumulate before the update — no staleness.
+
+Schedule: tick t runs stage s on microbatch ``t - s`` (valid range only),
+so a step costs S + M - 1 ticks for S stages x M microbatches — the classic
+bubble fraction (S-1)/(S+M-1); raise ``num_microbatches`` to amortize.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec
+
+from .mesh import MachineMesh
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: MachineMesh,
+                   num_microbatches: Optional[int] = None):
+    """Run ``y = stage_{S-1}(... stage_0(x))`` as a collective pipeline.
+
+    stage_fn(params, x) -> y with y.shape == x.shape (homogeneous stages);
+    ``stacked_params``: pytree whose leaves carry a leading stage dim S,
+    sharded over the mesh's ``p`` axis.  x: (n, ...) activations (may be
+    sharded over ``n``); returns same-shaped y.
+    """
+    leaves = jax.tree.leaves(stacked_params)
+    total_stages = leaves[0].shape[0]
+    for leaf in leaves:
+        assert leaf.shape[0] == total_stages, \
+            "all stacked leaves must share the stage dim"
+    S = mesh.axis_size("p")
+    if S <= 1:
+        # sequential fallback: same math, one stage after another
+        def body(h, params):
+            return stage_fn(params, h), None
+
+        y, _ = lax.scan(body, x, stacked_params)
+        return y
+
+    if total_stages % S != 0:
+        raise ValueError(
+            f"num_stages={total_stages} must be a multiple of the mesh 'p' "
+            f"axis size {S} (each rank runs a contiguous group of stages)")
+    M = num_microbatches or S
+    p_axes = mesh.subaxes("p")
+    n_axes = mesh.subaxes("n")
+    n_sharded = bool(n_axes) and x.shape[0] % (mesh.axis_size("n") * M) == 0
+    x_spec = PartitionSpec(n_axes if n_sharded else None,
+                           *([None] * (x.ndim - 1)))
+    pspec = jax.tree.map(
+        lambda a: PartitionSpec(p_axes, *([None] * (a.ndim - 1))),
+        stacked_params)
+
+    fn = partial(_pipeline_local, stage_fn=stage_fn, S=S, M=M, p_axes=p_axes)
+    return jax.shard_map(fn, mesh=mesh.mesh, in_specs=(pspec, x_spec),
+                         out_specs=x_spec, check_vma=False)(stacked_params, x)
+
+
+def _pipeline_local(stacked_local, x_loc, *, stage_fn, S: int, M: int,
+                    p_axes):
+    """Per-device GPipe loop (runs inside shard_map).  Each rank holds a
+    contiguous GROUP of stages (total_stages / S per rank, often 1) and
+    applies them in order within its tick."""
+    idx = lax.axis_index(p_axes)
+    n_loc = x_loc.shape[0]
+    assert n_loc % M == 0, (n_loc, M)
+    xm = x_loc.reshape((M, n_loc // M) + x_loc.shape[1:])
+    state0 = jnp.zeros_like(xm[0])
+    out0 = jnp.zeros_like(xm)
+    # activations hop s -> s+1; rank 0 has no upstream (it injects)
+    perm = [(j, j + 1) for j in range(S - 1)]
+
+    def run_group(x_in):
+        # scan this rank's local stage group in order
+        def body(h, params):
+            return stage_fn(params, h).astype(h.dtype), None
+
+        y, _ = lax.scan(body, x_in, stacked_local)
+        return y
+
+    def tick(carry, t):
+        state, out = carry
+        mb_in = xm[jnp.clip(t, 0, M - 1)]
+        x_in = jnp.where(idx == 0, mb_in, state)
+        y = run_group(x_in).astype(state.dtype)
+        m = t - (S - 1)  # microbatch the LAST stage just finished
+        emitted = out.at[jnp.clip(m, 0, M - 1)].set(y)
+        valid = (idx == S - 1) & (m >= 0)
+        out = jnp.where(valid, emitted, out)
+        state = lax.ppermute(y, p_axes, perm)
+        return (state, out), None
+
+    (state, out), _ = lax.scan(tick, (state0, out0), jnp.arange(S + M - 1))
+    # only the last rank holds real outputs; broadcast around the ring
+    out = lax.psum(jnp.where(idx == S - 1, out, jnp.zeros_like(out)), p_axes)
+    return out.reshape(x_loc.shape)

@@ -66,7 +66,14 @@ def param_spec(param: Parameter, pc: Optional[ParallelConfig],
     """Weight sharding.  DP weights are replicated (the reference keeps one
     logical weight region with per-replica grads); a channel-parallel op
     shards its weight on ``sharded_dim`` over axis 'c'
-    (reference create_linear_weight, model.cc:582-669)."""
+    (reference create_linear_weight, model.cc:582-669); pipeline-stacked
+    weights (shard_axis 'p') always shard their stage dim over 'p'."""
+    if param.shard_axis == "p":
+        if param.sharded_dim is None or mesh.axis_size("p") <= 1:
+            return PartitionSpec()
+        entries = [None] * len(param.shape)
+        entries[param.sharded_dim] = "p"
+        return PartitionSpec(*entries)
     if (pc is None or param.sharded_dim is None
             or mesh.axis_size("c") <= 1):
         return PartitionSpec()

@@ -44,10 +44,19 @@ def _factorizations(n: int, slots: int) -> List[Tuple[int, ...]]:
     return out
 
 
+_SEARCH_AXES = tuple(a for a in AXES if a != "p")  # "p" is op-less (stages)
+
+
 def candidate_meshes(num_devices: int) -> List[MeshShape]:
-    """Factorizations of the device count over the canonical axes."""
-    return [dict(zip(AXES, f))
-            for f in _factorizations(num_devices, len(AXES))]
+    """Factorizations of the device count over the per-op canonical axes
+    (the pipeline axis is sized explicitly by PipelineBlock users, not by
+    the per-op SOAP search)."""
+    out = []
+    for f in _factorizations(num_devices, len(_SEARCH_AXES)):
+        m = dict(zip(_SEARCH_AXES, f))
+        m["p"] = 1
+        out.append(m)
+    return out
 
 
 def _prod(xs) -> int:

@@ -82,6 +82,9 @@ class Parameter(Tensor):
     initializer: Optional[object] = None
     # model-parallel sharding hint resolved at compile()
     sharded_dim: Optional[int] = None
+    # mesh axis the sharded_dim maps to: "c" (tensor parallel, default) or
+    # "p" (pipeline-stage-stacked weights, parallel/pipeline.py)
+    shard_axis: str = "c"
     # False for op state (e.g. batchnorm running stats): excluded from the
     # optimizer, updated functionally via OpContext.updates
     trainable: bool = True

@@ -104,6 +104,33 @@ def test_load_checkpoint_validates_before_mutating(tmp_path):
         np.testing.assert_array_equal(before[k], np.asarray(c._params[k]))
 
 
+def test_load_checkpoint_rejects_shape_mismatch(tmp_path):
+    """Same names, different widths: must fail at load with a clear error,
+    not at the next train step."""
+    import pytest
+    x, y = _data()
+    a = _model()
+    a.save_checkpoint(os.path.join(tmp_path, "a.npz"))
+
+    cfg = ff.FFConfig(batch_size=16, compute_dtype="float32")
+    b = ff.FFModel(cfg, mesh=MachineMesh({"n": 1}))
+    xt = b.create_tensor((16, 8), name="x")
+    t = b.dense(xt, 64, activation="relu")  # 64 wide vs 32 in checkpoint
+    t = b.dense(t, 4)
+    b.compile(ff.SGDOptimizer(lr=0.1, momentum=0.9),
+              "sparse_categorical_crossentropy", [], final_tensor=t)
+    b.init_layers(seed=1)
+    with pytest.raises(ValueError, match="shape"):
+        b.load_checkpoint(os.path.join(tmp_path, "a.npz"))
+
+
+def test_initialize_distributed_rejects_unreachable_multihost():
+    import pytest
+    from flexflow_tpu.parallel import initialize_distributed
+    with pytest.raises(ValueError, match="coordinator"):
+        initialize_distributed(num_processes=4)
+
+
 def test_initialize_distributed_single_process_noop():
     """Single-host runs (incl. TPU_WORKER_HOSTNAMES=localhost) must skip
     jax.distributed and report a 1-process world."""

@@ -1,0 +1,102 @@
+"""Pipeline parallelism (GPipe collective pipeline over the 'p' mesh axis)
+— capability beyond the reference (SURVEY §2.15: FlexFlow has no stage
+pipeline).  Parity is exact because the p==1 fallback runs the same stacked
+weights through a lax.scan."""
+
+import jax
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.parallel.mesh import MachineMesh
+
+
+def _build(mesh_shape, M=None):
+    cfg = ff.FFConfig(batch_size=8, compute_dtype="float32")
+    model = ff.FFModel(cfg)
+    tok = model.create_tensor((8, 12), dtype="int32", name="tokens")
+    t = model.embedding(tok, 50, 32, aggr="none")
+    t = model.pipeline_transformer_block(t, num_stages=4, num_heads=4,
+                                         d_ff=64, num_microbatches=M)
+    cls = model.split(t, [1, 11], axis=1)[0]
+    cls = model.reshape(cls, (8, 32))
+    logits = model.dense(cls, 4)
+    model.compile(ff.SGDOptimizer(lr=0.1),
+                  "sparse_categorical_crossentropy", [],
+                  final_tensor=logits, mesh=MachineMesh(mesh_shape))
+    model.init_layers(seed=0)
+    return model
+
+
+def _train(mesh_shape, steps=4, M=None):
+    model = _build(mesh_shape, M)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 50, (8, 12)).astype(np.int32)
+    y = rng.integers(0, 4, (8, 1)).astype(np.int32)
+    return model, [float(model.train_batch(x, y)) for _ in range(steps)]
+
+
+def test_pipeline_parity_vs_single_device():
+    _, base = _train({"n": 1})
+    _, pp = _train({"p": 4})
+    np.testing.assert_allclose(base, pp, rtol=2e-4, atol=2e-5)
+
+
+def test_pipeline_composes_with_dp():
+    _, base = _train({"n": 1})
+    _, dppp = _train({"n": 2, "p": 4})
+    np.testing.assert_allclose(base, dppp, rtol=2e-4, atol=2e-5)
+
+
+def test_pipeline_more_microbatches_than_stages():
+    """M > S shrinks the bubble; numerics must not change."""
+    _, base = _train({"n": 1})
+    _, mb = _train({"p": 4}, M=8)
+    np.testing.assert_allclose(base, mb, rtol=2e-4, atol=2e-5)
+
+
+def test_pipeline_multiple_stages_per_rank():
+    """num_stages = 2x the p axis: each rank runs its 2-stage group in
+    order; parity with single device must hold."""
+    def build(mesh_shape):
+        cfg = ff.FFConfig(batch_size=8, compute_dtype="float32")
+        model = ff.FFModel(cfg)
+        tok = model.create_tensor((8, 12), dtype="int32", name="tokens")
+        t = model.embedding(tok, 50, 32, aggr="none")
+        t = model.pipeline_transformer_block(t, num_stages=4, num_heads=4,
+                                             d_ff=64)
+        cls = model.reshape(model.split(t, [1, 11], axis=1)[0], (8, 32))
+        logits = model.dense(cls, 4)
+        model.compile(ff.SGDOptimizer(lr=0.1),
+                      "sparse_categorical_crossentropy", [],
+                      final_tensor=logits, mesh=MachineMesh(mesh_shape))
+        model.init_layers(seed=0)
+        return model
+
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 50, (8, 12)).astype(np.int32)
+    y = rng.integers(0, 4, (8, 1)).astype(np.int32)
+    base = [float(build({"n": 1}).train_batch(x, y))]
+    m2 = build({"p": 2})  # 4 stages over 2 ranks -> 2 per rank
+    got = [float(m2.train_batch(x, y))]
+    np.testing.assert_allclose(base, got, rtol=2e-4, atol=2e-5)
+
+
+def test_pipeline_indivisible_stages_raises():
+    from flexflow_tpu.parallel.pipeline import pipeline_apply
+    import jax.numpy as jnp
+    mesh = MachineMesh({"p": 4})
+    stacked = {"w": jnp.zeros((6, 3, 3))}  # 6 stages on p=4
+    with pytest.raises(ValueError, match="multiple of"):
+        pipeline_apply(lambda p, x: x, stacked, jnp.zeros((8, 3)), mesh)
+
+
+def test_pipeline_weights_sharded_over_stage_axis():
+    """Each rank holds only its stage's slice — the memory scaling PP
+    exists for."""
+    model = _build({"p": 4})
+    w = model._params["pipeline_block/wq"]
+    assert w.shape[0] == 4
+    # stage dim sharded: each device's shard carries exactly 1 stage
+    shard_shapes = {tuple(s.data.shape) for s in w.addressable_shards}
+    assert shard_shapes == {(1, 32, 32)}, shard_shapes
