@@ -133,6 +133,17 @@ class PrefetchLoader:
         self.labels = np.asarray(labels)
         self.batch_size = batch_size or model.config.batch_size
         self.num_batches = self.labels.shape[0] // self.batch_size
+        dropped = self.labels.shape[0] - self.num_batches * self.batch_size
+        if self.num_batches == 0:
+            from ..fflogger import get_logger
+            get_logger("ff").warning(
+                f"dataset ({self.labels.shape[0]} samples) is smaller than "
+                f"batch_size={self.batch_size}: fit() will run ZERO steps")
+        elif dropped:
+            from ..fflogger import get_logger
+            get_logger("ff").info(
+                f"dropping {dropped} tail samples not filling a "
+                f"batch of {self.batch_size}")
 
     def _host_batch(self, it: int):
         sl = slice(it * self.batch_size, (it + 1) * self.batch_size)

@@ -1,0 +1,81 @@
+"""Structured logging with named categories.
+
+Reference: Legion logger channels — ``LegionRuntime::Logger::Category
+log_ff("ff")`` (src/runtime/model.cc:22), ``log_mapper("Mapper")``
+(src/mapper/mapper.cc:18) and the Python ``fflogger``
+(python/flexflow/core/flexflow_logger.py) — per-subsystem categories with
+runtime-controlled levels.  TPU-native shape:
+
+* ``get_logger("ff"|"mesh"|"search"|...)`` returns a category logger;
+* levels come from env: ``FF_LOG_LEVEL=debug|info|warning|error|none``
+  globally, refined per category via ``FF_LOG_LEVELS="search=debug,ff=info"``
+  (the reference's ``-level ff=2`` Legion flag equivalent);
+* ``Category.event(name, **fields)`` emits ONE machine-parseable JSON line
+  (``{"cat": ..., "event": ..., ...}``) to stdout — the structured per-step
+  metric stream the reference's printf-based PerfMetrics chain lacked.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any, Dict
+
+_LEVELS = {"debug": 10, "info": 20, "warning": 30, "error": 40, "none": 100}
+_DEFAULT_LEVEL = "info"
+
+
+def _configured_level(name: str) -> int:
+    per_cat = os.environ.get("FF_LOG_LEVELS", "")
+    for part in per_cat.split(","):
+        if "=" in part:
+            cat, _, lvl = part.partition("=")
+            if cat.strip() == name:
+                return _LEVELS.get(lvl.strip().lower(), _LEVELS["info"])
+    glob = os.environ.get("FF_LOG_LEVEL", _DEFAULT_LEVEL).lower()
+    return _LEVELS.get(glob, _LEVELS["info"])
+
+
+class Category:
+    """One named log channel (≙ one Legion Logger::Category)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.level = _configured_level(name)
+
+    def _emit(self, lvl: str, msg: str) -> None:
+        if _LEVELS[lvl] >= self.level:
+            print(f"[{self.name}] {lvl}: {msg}", file=sys.stderr, flush=True)
+
+    def debug(self, msg: str) -> None:
+        self._emit("debug", msg)
+
+    def info(self, msg: str) -> None:
+        self._emit("info", msg)
+
+    def warning(self, msg: str) -> None:
+        self._emit("warning", msg)
+
+    def error(self, msg: str) -> None:
+        self._emit("error", msg)
+
+    def event(self, event: str, **fields: Any) -> None:
+        """One JSON line per event on stdout (info level): the structured
+        metrics stream (e.g. one line per training epoch from fit())."""
+        if _LEVELS["info"] < self.level:
+            return
+        rec: Dict[str, Any] = {"cat": self.name, "event": event,
+                               "t": round(time.time(), 3)}
+        rec.update(fields)
+        print(json.dumps(rec), flush=True)
+
+
+_registry: Dict[str, Category] = {}
+
+
+def get_logger(name: str) -> Category:
+    if name not in _registry:
+        _registry[name] = Category(name)
+    return _registry[name]

@@ -52,11 +52,14 @@ class LearningRateScheduler(Callback):
         lr = float(self.schedule(epoch))
         opt = self.model.optimizer
         if hasattr(opt, "lr"):
-            opt.lr = lr         # SGD
+            attr = "lr"         # SGD
         elif hasattr(opt, "alpha"):
-            opt.alpha = lr      # Adam stores its rate as alpha
+            attr = "alpha"      # Adam stores its rate as alpha
         else:
             raise ValueError('Optimizer must have a "lr" attribute.')
+        if getattr(opt, attr) == lr:
+            return  # unchanged schedule value: keep the compiled step
+        setattr(opt, attr, lr)
         # the jitted step closes over the optimizer object; re-trace with
         # the new hyperparameter
         self.model._build_step_fns()

@@ -51,6 +51,20 @@ class PerfMetrics:
     def accuracy(self) -> float:
         return self.train_correct / max(1, self.train_all)
 
+    def scalars(self) -> Dict[str, float]:
+        """Per-sample means of every nonzero accumulator — the payload of
+        the structured per-epoch log event (fflogger)."""
+        n = max(1, self.train_all)
+        out: Dict[str, float] = {"samples_seen": float(self.train_all)}
+        if self.train_correct:
+            out["accuracy"] = self.accuracy
+        for k, v in (("cce", self.cce_loss), ("scce", self.sparse_cce_loss),
+                     ("mse", self.mse_loss), ("rmse", self.rmse_loss),
+                     ("mae", self.mae_loss)):
+            if v:
+                out[k] = v / n
+        return out
+
     def report(self, metrics: Sequence[str]) -> str:
         """Format like metrics_functions.cc:59-86."""
         parts = []

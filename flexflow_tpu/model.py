@@ -151,6 +151,18 @@ class FFModel:
             num_heads, d_ff, num_microbatches)
         return self._register(op).outputs[0]
 
+    def moe(self, input_tensor, num_experts, d_ff, k=2, capacity_factor=1.25,
+            activation="gelu", aux_loss_weight=1e-2, kernel_initializer=None,
+            name=None) -> Tensor:
+        """Mixture-of-Experts FFN with top-k routing and capacity-factor
+        dispatch over the 'e' mesh axis (beyond the reference — its closest
+        analogue is DLRM per-table placement, dlrm.cc:106,469)."""
+        from .ops.moe import MoE
+        op = MoE(self._uname("moe", name), input_tensor, num_experts, d_ff,
+                 k, capacity_factor, activation, aux_loss_weight,
+                 kernel_initializer)
+        return self._register(op).outputs[0]
+
     def multihead_attention(self, query, key=None, value=None, embed_dim=None,
                             num_heads=8, kdim=0, vdim=0, dropout=0.0,
                             bias=True, causal=False, kernel_initializer=None,
@@ -409,6 +421,12 @@ class FFModel:
         # -ll:tpu / --nodes bound the worker count (reference FFConfig)
         ndev = (self.config.num_devices if self.config.workers_per_node
                 else len(jax.devices()))
+        if ndev > len(jax.devices()):
+            from .fflogger import get_logger
+            get_logger("mesh").warning(
+                f"-ll:tpu/--nodes request {ndev} devices but only "
+                f"{len(jax.devices())} are visible; training on "
+                f"{len(jax.devices())}")
         ndev = min(ndev, len(jax.devices()))
         lcm = {"n": 1, "c": 1, "h": 1, "w": 1, "s": 1}
         mx = dict(lcm)
@@ -477,7 +495,8 @@ class FFModel:
                             flash_attention=cfg.flash_attention)
             inputs = {uid: x for uid, x in zip(input_uids, batch[:-1])}
             values = self._forward_values(params, inputs, ctx)
-            return values[loss_uid], values[final_uid], ctx.updates
+            aux = sum(ctx.aux_losses.values()) if ctx.aux_losses else 0.0
+            return values[loss_uid], values[final_uid], ctx.updates, aux
 
         if cfg.remat:
             forward_full = jax.checkpoint(forward_full,
@@ -485,9 +504,10 @@ class FFModel:
 
         def loss_and_metrics(trainable, frozen, batch, rng):
             params = {**frozen, **trainable}
-            logits, preds, updates = forward_full(params, batch, rng, True)
+            logits, preds, updates, aux = forward_full(params, batch, rng,
+                                                       True)
             labels = batch[-1]
-            loss = loss_fn(logits, labels)
+            loss = loss_fn(logits, labels) + aux
             sums = metrics_mod.compute_batch_metrics(
                 logits, labels, metric_names, loss_type)
             return loss, (updates, preds, sums)
@@ -531,7 +551,7 @@ class FFModel:
         def eval_step(params, batch, nvalid):
             """Masked eval: only the first ``nvalid`` rows (padded tail
             batches) contribute to loss/metric sums."""
-            logits, preds, _ = forward_full(params, batch, None, False)
+            logits, preds, _, _ = forward_full(params, batch, None, False)
             labels = batch[-1]
             mask = (jnp.arange(logits.shape[0]) < nvalid).astype(jnp.float32)
             loss_sum = jnp.sum(per_ex_fn(logits, labels) * mask)
@@ -623,10 +643,18 @@ class FFModel:
                                                                tiled=True))
         return np.asarray(v)
 
+    @staticmethod
+    def _ckpt_path(path: str) -> str:
+        # np.savez silently appends '.npz' to suffix-less paths; normalize
+        # here so save/load agree on the on-disk name
+        return path if path.endswith(".npz") else path + ".npz"
+
     def save_checkpoint(self, path: str) -> None:
         """Write params + optimizer state + step to one ``.npz``.  In
-        multi-host runs every process participates in the gather but only
-        process 0 writes the file."""
+        multi-host runs every process participates in the gather, only
+        process 0 writes the file, and all processes synchronize after the
+        write so peers never read a partially written checkpoint from
+        shared storage."""
         flat: Dict[str, np.ndarray] = {}
         for k, v in self._params.items():
             flat[f"param:{k}"] = self._gather_host(v)
@@ -635,7 +663,10 @@ class FFModel:
             flat[f"opt:{i}"] = self._gather_host(leaf)
         flat["meta:step"] = np.asarray(self._step, np.int64)
         if jax.process_index() == 0:
-            np.savez(path, **flat)
+            np.savez(self._ckpt_path(path), **flat)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("ff_checkpoint_written")
 
     def load_checkpoint(self, path: str) -> None:
         """Restore a checkpoint written by :meth:`save_checkpoint`,
@@ -643,7 +674,7 @@ class FFModel:
         Validates the full key set BEFORE mutating any state, so a graph or
         optimizer mismatch fails cleanly instead of half-restoring."""
         assert self._compiled, "call compile() + init_layers() first"
-        with np.load(path) as f:
+        with np.load(self._ckpt_path(path)) as f:
             ckpt_params = {k[len("param:"):] for k in f.files
                            if k.startswith("param:")}
             cur_params = set(self._params)
@@ -820,6 +851,15 @@ class FFModel:
                 if verbose:
                     print(f"epoch {epoch}: "
                           f"{self.perf_metrics.report(self.metrics or [self.loss_type])}")
+                # structured per-epoch record (one parseable JSON line; the
+                # reference only had printf metrics — SURVEY §5 observability)
+                from .fflogger import get_logger
+                get_logger("ff").event(
+                    "epoch", epoch=epoch, step=self._step,
+                    samples=total_samples,
+                    elapsed_s=round(time.time() - t_start, 3),
+                    **{k: round(float(v), 6)
+                       for k, v in self.perf_metrics.scalars().items()})
                 for cb in callbacks:
                     cb.on_epoch_end(epoch, self.perf_metrics)
                 if any(getattr(cb, "stop_training", False)

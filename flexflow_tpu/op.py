@@ -51,6 +51,7 @@ class OpType(enum.Enum):
     ATTENTION = "attention"
     LSTM = "lstm"
     PIPELINE = "pipeline"
+    MOE = "moe"
     INPUT = "input"
 
 
@@ -67,6 +68,9 @@ class OpContext:
     # non-trainable state (batchnorm running stats); the train step returns
     # them as part of the new params pytree
     updates: Dict[str, jax.Array] = dataclasses.field(default_factory=dict)
+    # auxiliary losses (e.g. MoE load balancing): {op_name: scalar}; the
+    # train step adds their sum to the objective
+    aux_losses: Dict[str, jax.Array] = dataclasses.field(default_factory=dict)
 
 
 class Op:

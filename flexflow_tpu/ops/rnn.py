@@ -64,8 +64,13 @@ class LSTM(Op):
         n, s, _ = x.shape
         h_sz = self.hidden_size
         wx = cast_compute(params[self.w_x.name], ctx)
-        wh = params[self.w_h.name].astype(jnp.float32)
+        # recurrent weights in the compute dtype: the per-step h @ Wh matmul
+        # must ride the MXU at bf16 rate (f32 here costs ~3x on v5e); f32
+        # accumulation comes from preferred_element_type below and the cell
+        # state stays f32 for numerical stability across timesteps
+        wh_t = cast_compute(params[self.w_h.name], ctx).T
         b = params[self.w_b.name].astype(jnp.float32)
+        compute_dt = wh_t.dtype
         # hoisted input projection: one big MXU matmul over all timesteps
         xg = jnp.einsum("nsd,gd->nsg", x, wx,
                         preferred_element_type=jnp.float32)   # (n,s,4H)
@@ -78,13 +83,17 @@ class LSTM(Op):
 
         def step(carry, xg_t):
             h, c = carry
-            gates = xg_t + h @ wh.T + b                       # (n,4H)
+            gates = xg_t + jnp.matmul(
+                h.astype(compute_dt), wh_t,
+                preferred_element_type=jnp.float32) + b       # (n,4H)
             i, f, g, o = jnp.split(gates, 4, axis=-1)
             c = (jax.nn.sigmoid(f + self.forget_bias) * c
                  + jax.nn.sigmoid(i) * jnp.tanh(g))
             h = jax.nn.sigmoid(o) * jnp.tanh(c)
             return (h, c), h
 
+        # measured on v5e: unroll>1 regresses (43.6% vs 53.7% MFU at n=256)
+        # — the unrolled body spills the f32 carries; keep the plain loop
         (h_n, c_n), hs = jax.lax.scan(step, (h0, c0),
                                       jnp.transpose(xg, (1, 0, 2)))
         seq = cast_compute(jnp.transpose(hs, (1, 0, 2)), ctx)

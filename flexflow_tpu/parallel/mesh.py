@@ -31,14 +31,15 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-AXES: Tuple[str, ...] = ("n", "c", "h", "w", "s", "p")
+AXES: Tuple[str, ...] = ("n", "c", "h", "w", "s", "e", "p")
 
-# readable aliases accepted in mesh_shape configs.  "p" is the pipeline-
-# stage axis: unlike the others it maps to no logical tensor dim
-# (dim_axis_names never yields it) — stages of a PipelineBlock shard their
-# stacked weights over it and activations ride a ppermute ring.
+# readable aliases accepted in mesh_shape configs.  "p" (pipeline stages)
+# and "e" (experts) map to no logical tensor dim (dim_axis_names never
+# yields them) — pipeline stages shard stacked weights over "p" with
+# activations on a ppermute ring; MoE expert weights shard over "e" with
+# token dispatch riding GSPMD's all_to_all.
 _ALIAS = {"data": "n", "batch": "n", "model": "c", "tensor": "c",
-          "seq": "s", "sequence": "s", "expert": "c", "pipeline": "p",
+          "seq": "s", "sequence": "s", "expert": "e", "pipeline": "p",
           "stage": "p"}
 
 

@@ -68,11 +68,13 @@ def param_spec(param: Parameter, pc: Optional[ParallelConfig],
     shards its weight on ``sharded_dim`` over axis 'c'
     (reference create_linear_weight, model.cc:582-669); pipeline-stacked
     weights (shard_axis 'p') always shard their stage dim over 'p'."""
-    if param.shard_axis == "p":
-        if param.sharded_dim is None or mesh.axis_size("p") <= 1:
+    if param.shard_axis in ("p", "e"):
+        # stage-stacked (pipeline) / expert-stacked (MoE) weights shard
+        # their leading stack dim over the dedicated mesh axis
+        if param.sharded_dim is None or mesh.axis_size(param.shard_axis) <= 1:
             return PartitionSpec()
         entries = [None] * len(param.shape)
-        entries[param.sharded_dim] = "p"
+        entries[param.sharded_dim] = param.shard_axis
         return PartitionSpec(*entries)
     if (pc is None or param.sharded_dim is None
             or mesh.axis_size("c") <= 1):

@@ -25,13 +25,41 @@ class DeviceSpec:
     mxu_flops: float = 459e12        # bf16 FLOP/s (v5p)
     vpu_flops: float = 7e12          # elementwise FLOP/s
     hbm_bw: float = 2765e9           # bytes/s
+    hbm_capacity: float = 95e9       # bytes per chip (v5p HBM)
     ici_bw: float = 90e9             # bytes/s per link direction
     dcn_bw: float = 25e9             # bytes/s per host (multi-slice)
     ici_latency: float = 1e-6        # s
     kernel_launch: float = 2e-6      # per-fused-region overhead (XLA amortizes)
 
 
-DEFAULT_SPEC = DeviceSpec()
+# Public spec-sheet figures per generation.
+V5P_SPEC = DeviceSpec()
+V5E_SPEC = DeviceSpec(mxu_flops=197e12, vpu_flops=4e12, hbm_bw=819e9,
+                      hbm_capacity=16e9, ici_bw=45e9)
+V6E_SPEC = DeviceSpec(mxu_flops=918e12, vpu_flops=9e12, hbm_bw=1640e9,
+                      hbm_capacity=32e9, ici_bw=90e9)
+
+_KIND_TO_SPEC = {
+    "TPU v5 lite": V5E_SPEC, "TPU v5e": V5E_SPEC,
+    "TPU v5": V5P_SPEC, "TPU v5p": V5P_SPEC,
+    "TPU v6 lite": V6E_SPEC, "TPU v6e": V6E_SPEC,
+}
+
+DEFAULT_SPEC = V5P_SPEC
+
+
+def spec_for_device(device_kind: str | None = None) -> DeviceSpec:
+    """Pick the DeviceSpec matching the attached chip (the reference bakes
+    one GPU fabric model into simulator.cu:27-29; we auto-select per
+    generation).  Unknown kinds (e.g. the CPU test backend) fall back to
+    DEFAULT_SPEC so virtual-mesh tests stay deterministic."""
+    if device_kind is None:
+        try:
+            import jax
+            device_kind = jax.devices()[0].device_kind
+        except Exception:
+            return DEFAULT_SPEC
+    return _KIND_TO_SPEC.get(device_kind, DEFAULT_SPEC)
 
 # ops whose arithmetic runs on the VPU, not the MXU
 _VPU_OPS = {
@@ -64,6 +92,46 @@ def op_compute_time(op: Op, part_degrees: Tuple[int, ...],
     if backward:
         io_bytes *= 2.0
     return max(flops / peak, io_bytes / spec.hbm_bw) + spec.kernel_launch
+
+
+def op_memory_bytes(op: Op, part_degrees: Tuple[int, ...],
+                    dtype_bytes: int = 2, opt_slot_bytes: int = 4,
+                    axes: Tuple[str, ...] = (), num_devices: int = 1) -> float:
+    """Per-chip resident bytes one op contributes to the training step's
+    high-water mark (reference: the simulator allocates its scratch from
+    real FB memory, simulator.cu:82-88, so unfittable strategies are
+    unrunnable there; here the accounting is explicit):
+
+    * parameters + their gradients (f32) + optimizer slots, sharded over
+      the ``c`` (channel/TP) degrees when the weight declares a
+      ``sharded_dim``, replicated otherwise;
+    * expert-/stage-stacked weights (``shard_axis`` 'e'/'p') are assumed
+      sharded over their dedicated mesh axis at its designed size
+      ``min(stack_extent, num_devices)`` — that axis is why the weight
+      declares the attribute, and the SOAP search never sizes e/p itself;
+    * the op's output activations (retained for backward), divided over
+      ALL partition degrees.
+    """
+    c_deg = 1
+    for deg, ax in zip(part_degrees, axes):
+        if ax == "c":
+            c_deg *= deg
+    nparts = 1
+    for d in part_degrees:
+        nparts *= d
+    total = 0.0
+    for w in op.weights:
+        per_param = w.volume * (4.0 * 2 + opt_slot_bytes)  # + grad + slots
+        stack_ax = getattr(w, "shard_axis", "c")
+        if stack_ax in ("e", "p") and w.sharded_dim is not None:
+            per_param /= max(1, min(w.shape[w.sharded_dim], num_devices))
+        elif (w.sharded_dim is not None and c_deg > 1
+                and w.shape[w.sharded_dim] % c_deg == 0):
+            per_param /= c_deg
+        total += per_param
+    for t in op.outputs:
+        total += t.volume * dtype_bytes / max(1, nparts)
+    return total
 
 
 def transfer_time(nbytes: float, intra_slice: bool,

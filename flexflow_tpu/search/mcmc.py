@@ -44,7 +44,9 @@ def _factorizations(n: int, slots: int) -> List[Tuple[int, ...]]:
     return out
 
 
-_SEARCH_AXES = tuple(a for a in AXES if a != "p")  # "p" is op-less (stages)
+# "p" (pipeline stages) and "e" (experts) are op-less axes sized by their
+# ops' users, not by the per-op SOAP search
+_SEARCH_AXES = tuple(a for a in AXES if a not in ("p", "e"))
 
 
 def candidate_meshes(num_devices: int) -> List[MeshShape]:
@@ -54,6 +56,7 @@ def candidate_meshes(num_devices: int) -> List[MeshShape]:
     out = []
     for f in _factorizations(num_devices, len(_SEARCH_AXES)):
         m = dict(zip(_SEARCH_AXES, f))
+        m["e"] = 1
         m["p"] = 1
         out.append(m)
     return out
@@ -67,11 +70,18 @@ def _prod(xs) -> int:
 
 
 def legal_configs(op: Op, mesh_shape: MeshShape,
-                  max_candidates: int = 64) -> List[ParallelConfig]:
+                  max_candidates: int = 1024,
+                  seed: int = 0) -> List[ParallelConfig]:
     """Legal configs for one op under a fixed mesh factorization: each
     output dim's degree is a divisor of its canonical axis size (all
     divisors are sub-axis-expressible) that also divides the dim extent
-    (reference Op::get_random_parallel_config, model.cc:276-305)."""
+    (reference Op::get_random_parallel_config, model.cc:276-305).
+
+    The FULL cartesian product is enumerated; only when it exceeds
+    ``max_candidates`` does a seeded uniform sample (always including the
+    all-ones config) replace it, and the cut is logged — never silent.
+    Index-based sampling keeps every corner of the space (e.g. pure-h/w
+    splits late in the product order) reachable."""
     out_t = op.outputs[0]
     nd = out_t.num_dims
     allowed = op.parallel_dims()
@@ -86,11 +96,32 @@ def legal_configs(op: Op, mesh_shape: MeshShape,
         degs = tuple(d for d in expressible_degrees(mesh_shape[ax])
                      if out_t.shape[i] % d == 0)
         per_dim.append(degs or (1,))
-    import itertools
+    total = _prod(len(d) for d in per_dim)
+    if total <= max_candidates:
+        import itertools
+        combos = list(itertools.product(*per_dim))
+    else:
+        import zlib
 
+        from ..fflogger import get_logger
+        get_logger("search").warning(
+            f"{op.name}: {total} legal configs exceed max_candidates="
+            f"{max_candidates}; sampling uniformly (seeded)")
+        # crc32, not hash(): str hashing is salted per-process and would
+        # break cross-run reproducibility of the sampled space
+        key = f"{seed}:{op.name}:{sorted(mesh_shape.items())}"
+        rng = random.Random(zlib.crc32(key.encode()))
+        picks = set(rng.sample(range(total), max_candidates))
+        picks.add(0)  # index 0 = all-ones (replicated) — always legal
+        combos = []
+        for flat in sorted(picks):
+            dims = []
+            for choices in reversed(per_dim):
+                flat, r = divmod(flat, len(choices))
+                dims.append(choices[r])
+            combos.append(tuple(reversed(dims)))
     return [ParallelConfig(dims=dims, device_ids=tuple(range(_prod(dims))))
-            for dims in itertools.islice(
-                itertools.product(*per_dim), max_candidates)]
+            for dims in combos]
 
 
 def snap_config(pc: ParallelConfig, op: Op,
@@ -118,7 +149,7 @@ def snap_config(pc: ParallelConfig, op: Op,
 
 def search(layers: List[Op], num_devices: int, budget: int = 1000,
            alpha: float = 0.05, seed: int = 0,
-           spec: DeviceSpec = DEFAULT_SPEC, measure: bool = False,
+           spec: Optional[DeviceSpec] = None, measure: bool = False,
            overlap_backward_update: bool = False,
            verbose: bool = False, flash_attention: bool = False
            ) -> Tuple[Dict[str, ParallelConfig], MeshShape, float]:
@@ -139,7 +170,7 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
     def cands(op: Op, ms: MeshShape) -> List[ParallelConfig]:
         key = (op.name, tuple(ms[a] for a in AXES))
         if key not in cand_cache:
-            cand_cache[key] = legal_configs(op, ms)
+            cand_cache[key] = legal_configs(op, ms, seed=seed)
         return cand_cache[key]
 
     current: Dict[str, ParallelConfig] = {}
@@ -173,8 +204,15 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
             prop_mesh = mesh_shape
         new_time = sim.simulate(layers, proposal, overlap_backward_update)
         delta = new_time - cur_time
-        if delta < 0 or (math.isfinite(new_time) and
-                         rng.random() < math.exp(-alpha * delta * 1e3)):
+        # inf -> inf moves are accepted unconditionally: when the start
+        # point is infeasible (e.g. DP blows the HBM budget) the walk must
+        # be able to drift across infeasible states (mesh refactorizations)
+        # until a feasible one appears; the reference never needs this
+        # because its DP start always fits (it measures on the real GPU)
+        both_inf = (not math.isfinite(new_time)
+                    and not math.isfinite(cur_time))
+        if both_inf or delta < 0 or (math.isfinite(new_time) and
+                                     rng.random() < math.exp(-alpha * delta * 1e3)):
             current, cur_time, mesh_shape = proposal, new_time, prop_mesh
             if cur_time < best_time:
                 best, best_mesh, best_time = (dict(current), dict(mesh_shape),

@@ -26,7 +26,8 @@ from ..config import FFConfig, ParallelConfig
 from ..op import Op
 from ..tensor import Tensor
 from .cost_model import (DEFAULT_SPEC, DeviceSpec, allreduce_time,
-                         op_compute_time, transfer_time)
+                         op_compute_time, op_memory_bytes, spec_for_device,
+                         transfer_time)
 
 
 class SimTask:
@@ -73,11 +74,11 @@ def _overlap_volume(lo1, hi1, lo2, hi2) -> int:
 
 
 class Simulator:
-    def __init__(self, spec: DeviceSpec = DEFAULT_SPEC,
+    def __init__(self, spec: Optional[DeviceSpec] = None,
                  num_devices: int = 1, devices_per_slice: int = 0,
                  measure: bool = False, dtype_bytes: int = 2,
                  use_native: bool = True, flash_attention: bool = False):
-        self.spec = spec
+        self.spec = spec if spec is not None else spec_for_device()
         self.num_devices = num_devices
         self.devices_per_slice = devices_per_slice or num_devices
         self.measure = measure
@@ -183,6 +184,28 @@ class Simulator:
                         wb, min(repl * c_deg, self.num_devices), self.spec)
         return pc, dims, ft, bt, sync
 
+    def peak_memory_bytes(self, layers: List[Op],
+                          strategies: Dict[str, ParallelConfig]) -> float:
+        """Per-chip HBM high-water estimate for a strategy: params + grads +
+        optimizer slots (sharded over TP degrees) + retained activations
+        (sharded over all degrees).  The reference grounds legality in real
+        FB memory (simulator.cu:82-88); this is the explicit TPU analogue."""
+        from ..parallel.mesh import dim_axis_names
+        total = 0.0
+        for op in layers:
+            pc = strategies.get(op.name)
+            out = op.outputs[0]
+            if pc is None:
+                dims = tuple(ParallelConfig.data_parallel(
+                    min(self.num_devices, out.shape[0]), out.num_dims).dims)
+            else:
+                dims = tuple(pc.dims[: out.num_dims]) + \
+                    (1,) * max(0, out.num_dims - len(pc.dims))
+            total += op_memory_bytes(op, dims, self.dtype_bytes,
+                                     axes=dim_axis_names(out.num_dims),
+                                     num_devices=self.num_devices)
+        return total
+
     def _simulate_native(self, layers: List[Op],
                          strategies: Dict[str, ParallelConfig],
                          overlap_backward_update: bool) -> float:
@@ -250,8 +273,13 @@ class Simulator:
                  strategies: Dict[str, ParallelConfig],
                  overlap_backward_update: bool = False) -> float:
         """Simulated per-iteration runtime (seconds) — the MCMC objective
-        (reference simulate_runtime, simulator.cc:275-448).  Runs the C++
-        engine when available (native/simulator.cpp), else pure Python."""
+        (reference simulate_runtime, simulator.cc:275-448).  Strategies whose
+        per-chip memory exceeds the spec's HBM capacity are unrunnable and
+        score inf (reference: simulator scratch comes from real FB memory,
+        simulator.cu:82-88).  Runs the C++ engine when available
+        (native/simulator.cpp), else pure Python."""
+        if self.peak_memory_bytes(layers, strategies) > self.spec.hbm_capacity:
+            return float("inf")
         if self._native is not None:
             t = self._simulate_native(layers, strategies,
                                       overlap_backward_update)

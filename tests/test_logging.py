@@ -1,0 +1,59 @@
+"""Structured logging tests (VERDICT Missing#5: reference logger categories
+model.cc:22, mapper.cc:18, flexflow_logger.py)."""
+
+import json
+
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.fflogger import Category, get_logger
+
+
+def test_category_levels(monkeypatch, capsys):
+    monkeypatch.setenv("FF_LOG_LEVEL", "warning")
+    cat = Category("testcat")
+    cat.info("hidden")
+    cat.warning("shown")
+    err = capsys.readouterr().err
+    assert "hidden" not in err
+    assert "[testcat] warning: shown" in err
+
+
+def test_per_category_override(monkeypatch, capsys):
+    monkeypatch.setenv("FF_LOG_LEVEL", "error")
+    monkeypatch.setenv("FF_LOG_LEVELS", "chatty=debug")
+    quiet, chatty = Category("quiet"), Category("chatty")
+    quiet.info("no")
+    chatty.debug("yes")
+    err = capsys.readouterr().err
+    assert "no" not in err
+    assert "[chatty] debug: yes" in err
+
+
+def test_event_json_line(capsys):
+    get_logger("ff").event("epoch", epoch=3, loss=1.5)
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert rec["cat"] == "ff" and rec["event"] == "epoch"
+    assert rec["epoch"] == 3 and rec["loss"] == 1.5
+
+
+def test_fit_emits_epoch_event(capsys):
+    cfg = ff.FFConfig(batch_size=16, compute_dtype="float32")
+    model = ff.FFModel(cfg, mesh=ff.MachineMesh({"n": 1}))
+    x = model.create_tensor((16, 8), name="x")
+    t = model.dense(x, 4)
+    model.compile(ff.SGDOptimizer(lr=0.1), "sparse_categorical_crossentropy",
+                  ["accuracy"], final_tensor=t)
+    model.init_layers(seed=0)
+    rng = np.random.default_rng(0)
+    model.fit(rng.standard_normal((32, 8), dtype=np.float32),
+              rng.integers(0, 4, (32, 1)).astype(np.int32),
+              epochs=2, verbose=False)
+    out = capsys.readouterr().out
+    events = [json.loads(l) for l in out.splitlines()
+              if l.startswith("{") and '"event": "epoch"' in l]
+    assert len(events) == 2
+    assert events[1]["epoch"] == 1
+    assert events[1]["samples"] == 64
+    assert "accuracy" in events[1]

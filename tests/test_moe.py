@@ -1,0 +1,100 @@
+"""MoE expert-parallelism tests (VERDICT round-2 ask #5).
+
+Parity contracts: (a) a 1-expert MoE with ample capacity IS the plain FFN;
+(b) the same MoE model produces identical results on a single device and on
+a dp2 x ep4 mesh (expert weights sharded over 'e', token dispatch via
+GSPMD all_to_all)."""
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+
+
+def _data(rng, batch, s, d, classes=8):
+    x = rng.standard_normal((batch, s, d)).astype(np.float32)
+    y = rng.integers(0, classes, (batch, 1)).astype(np.int32)
+    return x, y
+
+
+def _build(mesh_shape, batch=16, s=8, d=32, E=4, k=2, cf=1.25, aux=1e-2,
+           seed=0):
+    cfg = ff.FFConfig(batch_size=batch, compute_dtype="float32")
+    model = ff.FFModel(cfg, mesh=ff.MachineMesh(mesh_shape))
+    x = model.create_tensor((batch, s, d), name="x")
+    t = model.moe(x, E, d_ff=64, k=k, capacity_factor=cf,
+                  aux_loss_weight=aux, name="moe0")
+    t = model.flat(t)
+    t = model.dense(t, 8, name="head")
+    model.compile(ff.SGDOptimizer(lr=0.05),
+                  "sparse_categorical_crossentropy", ["accuracy"],
+                  final_tensor=t)
+    model.init_layers(seed=seed)
+    return model
+
+
+def test_single_expert_equals_dense_ffn():
+    rng = np.random.default_rng(0)
+    batch, s, d = 4, 6, 16
+    cfg = ff.FFConfig(batch_size=batch, compute_dtype="float32")
+    model = ff.FFModel(cfg, mesh=ff.MachineMesh({"n": 1}))
+    x = model.create_tensor((batch, s, d), name="x")
+    model.moe(x, num_experts=1, d_ff=32, k=1, capacity_factor=1.0,
+              activation="relu", aux_loss_weight=0.0, name="moe0")
+    model.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error", [],
+                  final_tensor=model.layers[-1].outputs[0])
+    model.init_layers(seed=3)
+    xd = rng.standard_normal((batch, s, d)).astype(np.float32)
+    out = model.predict(xd, batch_size=batch)
+    w1 = model.get_weights("moe0/w_up")[0]      # (d_ff, d)
+    b1 = model.get_weights("moe0/w_up_bias")[0]
+    w2 = model.get_weights("moe0/w_down")[0]    # (d, d_ff)
+    b2 = model.get_weights("moe0/w_down_bias")[0]
+    ref = np.maximum(xd @ w1.T + b1, 0.0) @ w2.T + b2
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_moe_mesh_parity_dp_ep():
+    """Same seed, same data: single-device == dp2/ep4 sharded execution."""
+    rng = np.random.default_rng(1)
+    xd, yd = _data(rng, 16, 8, 32)
+    m1 = _build({"n": 1})
+    m2 = _build({"n": 2, "expert": 4})
+    assert m2.mesh.axis_size("e") == 4
+    p1 = m1.predict(xd)
+    p2 = m2.predict(xd)
+    np.testing.assert_allclose(p1, p2, rtol=2e-4, atol=2e-4)
+    l1 = [float(m1.train_batch(xd, yd)) for _ in range(3)]
+    l2 = [float(m2.train_batch(xd, yd)) for _ in range(3)]
+    np.testing.assert_allclose(l1, l2, rtol=2e-4, atol=2e-4)
+    assert l1[-1] < l1[0]  # actually learning
+
+
+def test_capacity_drops_tokens():
+    """A tiny capacity factor forces overflow: outputs for dropped tokens
+    are zero-combined, so shrinking capacity must change the output."""
+    rng = np.random.default_rng(2)
+    xd = rng.standard_normal((8, 4, 16)).astype(np.float32)
+    outs = []
+    for cf in (4.0, 0.25):
+        cfg = ff.FFConfig(batch_size=8, compute_dtype="float32")
+        model = ff.FFModel(cfg, mesh=ff.MachineMesh({"n": 1}))
+        x = model.create_tensor((8, 4, 16), name="x")
+        model.moe(x, num_experts=4, d_ff=32, k=1, capacity_factor=cf,
+                  name="moe0")
+        model.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error", [],
+                      final_tensor=model.layers[-1].outputs[0])
+        model.init_layers(seed=5)
+        outs.append(model.predict(xd, batch_size=8))
+    assert np.abs(outs[0] - outs[1]).max() > 1e-4
+
+
+def test_aux_loss_feeds_objective():
+    rng = np.random.default_rng(3)
+    xd, yd = _data(rng, 16, 8, 32)
+    m_aux = _build({"n": 1}, aux=0.5, seed=7)
+    m_no = _build({"n": 1}, aux=0.0, seed=7)
+    la = float(m_aux.train_batch(xd, yd))
+    ln = float(m_no.train_batch(xd, yd))
+    # Switch aux loss is ~1 for a fresh router; weight 0.5 must show up
+    assert la > ln + 0.1
