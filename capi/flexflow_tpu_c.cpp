@@ -74,6 +74,30 @@ const char* loss_name(flexflow_loss_t l) {
   }
 }
 
+// per-dtype element size (np.dtype(name).itemsize, cached).  On failure
+// the pending CPython exception is consumed into g_err — leaving it set
+// would poison the next unrelated API call.
+Py_ssize_t dtype_itemsize(const char* dtype) {
+  static std::vector<std::pair<std::string, Py_ssize_t>> cache;
+  for (auto& kv : cache)
+    if (kv.first == dtype) return kv.second;
+  PyObject* d = PyObject_CallMethod(g_np, "dtype", "s", dtype);
+  if (!d) {
+    set_err_from_python();
+    return -1;
+  }
+  PyObject* sz = PyObject_GetAttrString(d, "itemsize");
+  Py_DECREF(d);
+  if (!sz) {
+    set_err_from_python();
+    return -1;
+  }
+  Py_ssize_t v = PyLong_AsSsize_t(sz);
+  Py_DECREF(sz);
+  cache.emplace_back(dtype, v);
+  return v;
+}
+
 // numpy array viewing a host buffer: np.frombuffer(memoryview, dtype)
 // .reshape(shape).  Returns a new reference or nullptr.
 PyObject* buffer_to_ndarray(const void* data, PyObject* shape_tuple,
@@ -81,7 +105,12 @@ PyObject* buffer_to_ndarray(const void* data, PyObject* shape_tuple,
   Py_ssize_t n = 1;
   for (Py_ssize_t i = 0; i < PyTuple_Size(shape_tuple); i++)
     n *= PyLong_AsLongLong(PyTuple_GetItem(shape_tuple, i));
-  Py_ssize_t nbytes = n * 4;  // float32 / int32
+  Py_ssize_t isz = dtype_itemsize(dtype);
+  if (isz <= 0) {
+    g_err = std::string("unknown dtype ") + dtype;
+    return nullptr;
+  }
+  Py_ssize_t nbytes = n * isz;
   PyObject* mv = PyMemoryView_FromMemory(
       const_cast<char*>(static_cast<const char*>(data)), nbytes, PyBUF_READ);
   if (!mv) return nullptr;
@@ -249,10 +278,12 @@ flexflow_tensor_t flexflow_model_create_tensor(
   PyObject* shape = PyTuple_New(ndims);
   for (int i = 0; i < ndims; i++)
     PyTuple_SetItem(shape, i, PyLong_FromLongLong(dims[i]));
+  const char* dt = "float32";
+  if (dtype == FF_DT_INT32) dt = "int32";
+  else if (dtype == FF_DT_INT64) dt = "int64";
+  else if (dtype == FF_DT_DOUBLE) dt = "float64";
   PyObject* t = PyObject_CallMethod(
-      obj(m), "create_tensor", "Oss", shape,
-      dtype == FF_DT_INT32 ? "int32" : "float32",
-      name ? name : "input");
+      obj(m), "create_tensor", "Oss", shape, dt, name ? name : "input");
   Py_DECREF(shape);
   if (!t) {
     set_err_from_python();
@@ -309,6 +340,18 @@ static void kw_set_bool(PyObject* kw, const char* k, int v) {
   PyDict_SetItemString(kw, k, v ? Py_True : Py_False);
 }
 
+static void kw_set_double(PyObject* kw, const char* k, double v) {
+  PyObject* o = PyFloat_FromDouble(v);
+  PyDict_SetItemString(kw, k, o);
+  Py_DECREF(o);
+}
+
+static void kw_set_long(PyObject* kw, const char* k, long v) {
+  PyObject* o = PyLong_FromLong(v);
+  PyDict_SetItemString(kw, k, o);
+  Py_DECREF(o);
+}
+
 flexflow_tensor_t flexflow_model_conv2d(
     flexflow_model_t m, flexflow_tensor_t input, int out_channels,
     int kernel_h, int kernel_w, int stride_h, int stride_w,
@@ -349,9 +392,10 @@ flexflow_tensor_t flexflow_model_dense(
 
 flexflow_tensor_t flexflow_model_embedding(
     flexflow_model_t m, flexflow_tensor_t input, int num_entries,
-    int out_dim, const char* name) {
+    int out_dim, const char* aggr, const char* name) {
   PyObject* args = Py_BuildValue("(Oii)", obj(input), num_entries, out_dim);
   PyObject* kw = PyDict_New();
+  kw_set_str(kw, "aggr", aggr ? aggr : "sum");
   kw_set_str(kw, "name", name);
   return call_op(call_kw(obj(m), "embedding", args, kw));
 }
@@ -427,6 +471,220 @@ flexflow_tensor_t flexflow_model_mse_loss(flexflow_model_t m,
   return call_op(call_kw(obj(m), "mse_loss", args, kw));
 }
 
+flexflow_tensor_t flexflow_model_unary(flexflow_model_t m, const char* op,
+                                       flexflow_tensor_t input,
+                                       const char* name) {
+  PyObject* args = Py_BuildValue("(O)", obj(input));
+  PyObject* kw = PyDict_New();
+  kw_set_str(kw, "name", name);
+  // FFModel exposes each unary as its own method (relu/gelu/exp/...)
+  return call_op(call_kw(obj(m), op, args, kw));
+}
+
+flexflow_tensor_t flexflow_model_binary(flexflow_model_t m, const char* op,
+                                        flexflow_tensor_t a,
+                                        flexflow_tensor_t b,
+                                        const char* name) {
+  const char* meth = op;
+  if (strcmp(op, "sub") == 0) meth = "subtract";
+  else if (strcmp(op, "mul") == 0) meth = "multiply";
+  else if (strcmp(op, "div") == 0) meth = "divide";
+  PyObject* args = Py_BuildValue("(OO)", obj(a), obj(b));
+  PyObject* kw = PyDict_New();
+  kw_set_str(kw, "name", name);
+  return call_op(call_kw(obj(m), meth, args, kw));
+}
+
+flexflow_tensor_t flexflow_model_layer_norm(flexflow_model_t m,
+                                            flexflow_tensor_t input,
+                                            const char* name) {
+  PyObject* args = Py_BuildValue("(O)", obj(input));
+  PyObject* kw = PyDict_New();
+  kw_set_str(kw, "name", name);
+  return call_op(call_kw(obj(m), "layer_norm", args, kw));
+}
+
+flexflow_tensor_t flexflow_model_rms_norm(flexflow_model_t m,
+                                          flexflow_tensor_t input,
+                                          const char* name) {
+  PyObject* args = Py_BuildValue("(O)", obj(input));
+  PyObject* kw = PyDict_New();
+  kw_set_str(kw, "name", name);
+  return call_op(call_kw(obj(m), "rms_norm", args, kw));
+}
+
+int flexflow_model_split(flexflow_model_t m, flexflow_tensor_t input,
+                         int n_outputs, int axis, flexflow_tensor_t* outputs,
+                         const char* name) {
+  PyObject* args = Py_BuildValue("(Oii)", obj(input), n_outputs, axis);
+  PyObject* kw = PyDict_New();
+  kw_set_str(kw, "name", name);
+  PyObject* lst = call_kw(obj(m), "split", args, kw);
+  if (!lst) {
+    set_err_from_python();
+    return -1;
+  }
+  for (int i = 0; i < n_outputs; i++) {
+    PyObject* t = PySequence_GetItem(lst, i);  // new ref
+    if (!t) {
+      set_err_from_python();
+      for (int j = 0; j < i; j++) {  // release partial results on error
+        unwrap_free(outputs[j]);
+        outputs[j] = nullptr;
+      }
+      Py_DECREF(lst);
+      return -1;
+    }
+    outputs[i] = (flexflow_tensor_t)wrap(t);
+  }
+  Py_DECREF(lst);
+  return 0;
+}
+
+flexflow_tensor_t flexflow_model_reshape(flexflow_model_t m,
+                                         flexflow_tensor_t input, int ndims,
+                                         const int64_t* dims,
+                                         const char* name) {
+  PyObject* shape = PyTuple_New(ndims);
+  for (int i = 0; i < ndims; i++)
+    PyTuple_SetItem(shape, i, PyLong_FromLongLong(dims[i]));
+  PyObject* args = Py_BuildValue("(OO)", obj(input), shape);
+  Py_DECREF(shape);
+  PyObject* kw = PyDict_New();
+  kw_set_str(kw, "name", name);
+  return call_op(call_kw(obj(m), "reshape", args, kw));
+}
+
+flexflow_tensor_t flexflow_model_transpose(flexflow_model_t m,
+                                           flexflow_tensor_t input, int ndims,
+                                           const int* perm,
+                                           const char* name) {
+  PyObject* p = PyTuple_New(ndims);
+  for (int i = 0; i < ndims; i++)
+    PyTuple_SetItem(p, i, PyLong_FromLong(perm[i]));
+  PyObject* args = Py_BuildValue("(OO)", obj(input), p);
+  Py_DECREF(p);
+  PyObject* kw = PyDict_New();
+  kw_set_str(kw, "name", name);
+  return call_op(call_kw(obj(m), "transpose", args, kw));
+}
+
+flexflow_tensor_t flexflow_model_multihead_attention(
+    flexflow_model_t m, flexflow_tensor_t query, flexflow_tensor_t key,
+    flexflow_tensor_t value, int embed_dim, int num_heads, float dropout,
+    int use_bias, int causal, const char* name) {
+  PyObject* args = Py_BuildValue("(O)", obj(query));
+  PyObject* kw = PyDict_New();
+  if (key) PyDict_SetItemString(kw, "key", obj(key));
+  if (value) PyDict_SetItemString(kw, "value", obj(value));
+  kw_set_long(kw, "embed_dim", embed_dim);
+  kw_set_long(kw, "num_heads", num_heads);
+  kw_set_double(kw, "dropout", dropout);
+  kw_set_bool(kw, "bias", use_bias);
+  kw_set_bool(kw, "causal", causal);
+  kw_set_str(kw, "name", name);
+  return call_op(call_kw(obj(m), "multihead_attention", args, kw));
+}
+
+flexflow_tensor_t flexflow_model_position_embedding(flexflow_model_t m,
+                                                    flexflow_tensor_t input,
+                                                    const char* name) {
+  PyObject* args = Py_BuildValue("(O)", obj(input));
+  PyObject* kw = PyDict_New();
+  kw_set_str(kw, "name", name);
+  return call_op(call_kw(obj(m), "position_embedding", args, kw));
+}
+
+flexflow_tensor_t flexflow_model_lstm(flexflow_model_t m,
+                                      flexflow_tensor_t input,
+                                      int hidden_size,
+                                      flexflow_tensor_t h_init,
+                                      flexflow_tensor_t c_init,
+                                      flexflow_tensor_t* h_out,
+                                      flexflow_tensor_t* c_out,
+                                      const char* name) {
+  PyObject* args = Py_BuildValue("(Oi)", obj(input), hidden_size);
+  PyObject* kw = PyDict_New();
+  if (h_init && c_init) {
+    PyObject* st = PyTuple_Pack(2, obj(h_init), obj(c_init));
+    PyDict_SetItemString(kw, "initial_state", st);
+    Py_DECREF(st);
+  }
+  kw_set_str(kw, "name", name);
+  PyObject* tup = call_kw(obj(m), "lstm", args, kw);
+  if (!tup) {
+    set_err_from_python();
+    return nullptr;
+  }
+  PyObject* seq = PySequence_GetItem(tup, 0);
+  if (h_out) *h_out = (flexflow_tensor_t)wrap(PySequence_GetItem(tup, 1));
+  if (c_out) *c_out = (flexflow_tensor_t)wrap(PySequence_GetItem(tup, 2));
+  Py_DECREF(tup);
+  return (flexflow_tensor_t)wrap(seq);
+}
+
+flexflow_tensor_t flexflow_model_moe(flexflow_model_t m,
+                                     flexflow_tensor_t input, int num_experts,
+                                     int d_ff, int k, float capacity_factor,
+                                     const char* name) {
+  PyObject* args = Py_BuildValue("(Oii)", obj(input), num_experts, d_ff);
+  PyObject* kw = PyDict_New();
+  kw_set_long(kw, "k", k);
+  kw_set_double(kw, "capacity_factor", capacity_factor);
+  kw_set_str(kw, "name", name);
+  return call_op(call_kw(obj(m), "moe", args, kw));
+}
+
+/* ---- optimizer handles ---- */
+
+flexflow_optimizer_handle_t flexflow_sgd_optimizer_create(
+    double lr, double momentum, int nesterov, double weight_decay) {
+  if (flexflow_init() != 0) return nullptr;
+  PyObject* cls = PyObject_GetAttrString(g_ff, "SGDOptimizer");
+  PyObject* kw = PyDict_New();
+  kw_set_double(kw, "lr", lr);
+  kw_set_double(kw, "momentum", momentum);
+  kw_set_bool(kw, "nesterov", nesterov);
+  kw_set_double(kw, "weight_decay", weight_decay);
+  PyObject* empty = PyTuple_New(0);
+  PyObject* o = PyObject_Call(cls, empty, kw);
+  Py_DECREF(cls);
+  Py_DECREF(empty);
+  Py_DECREF(kw);
+  if (!o) {
+    set_err_from_python();
+    return nullptr;
+  }
+  return (flexflow_optimizer_handle_t)wrap(o);
+}
+
+flexflow_optimizer_handle_t flexflow_adam_optimizer_create(
+    double alpha, double beta1, double beta2, double weight_decay,
+    double epsilon) {
+  if (flexflow_init() != 0) return nullptr;
+  PyObject* cls = PyObject_GetAttrString(g_ff, "AdamOptimizer");
+  PyObject* kw = PyDict_New();
+  kw_set_double(kw, "alpha", alpha);
+  kw_set_double(kw, "beta1", beta1);
+  kw_set_double(kw, "beta2", beta2);
+  kw_set_double(kw, "weight_decay", weight_decay);
+  kw_set_double(kw, "epsilon", epsilon);
+  PyObject* empty = PyTuple_New(0);
+  PyObject* o = PyObject_Call(cls, empty, kw);
+  Py_DECREF(cls);
+  Py_DECREF(empty);
+  Py_DECREF(kw);
+  if (!o) {
+    set_err_from_python();
+    return nullptr;
+  }
+  return (flexflow_optimizer_handle_t)wrap(o);
+}
+
+void flexflow_optimizer_destroy(flexflow_optimizer_handle_t o) {
+  unwrap_free(o);
+}
+
 /* ---- compile + verbs ---- */
 
 int flexflow_model_compile(flexflow_model_t m, flexflow_optimizer_t opt,
@@ -453,6 +711,23 @@ int flexflow_model_compile(flexflow_model_t m, flexflow_optimizer_t opt,
                        final_tensor ? obj(final_tensor) : Py_None);
   PyObject* r = call_kw(obj(m), "compile", args, kw);
   Py_DECREF(opt_obj);
+  if (!r) {
+    set_err_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int flexflow_model_compile_opt(flexflow_model_t m,
+                               flexflow_optimizer_handle_t opt,
+                               flexflow_loss_t loss,
+                               flexflow_tensor_t final_tensor) {
+  PyObject* args = Py_BuildValue("(Os)", obj(opt), loss_name(loss));
+  PyObject* kw = PyDict_New();
+  PyDict_SetItemString(kw, "final_tensor",
+                       final_tensor ? obj(final_tensor) : Py_None);
+  PyObject* r = call_kw(obj(m), "compile", args, kw);
   if (!r) {
     set_err_from_python();
     return -1;
@@ -593,6 +868,77 @@ int flexflow_model_set_weights(flexflow_model_t m, const char* name,
   }
   Py_DECREF(r);
   return 0;
+}
+
+/* ---- strategy files ---- */
+
+int flexflow_model_import_strategies(flexflow_model_t m, const char* path) {
+  PyObject* cfg = PyObject_GetAttrString(obj(m), "config");
+  if (!cfg) {
+    set_err_from_python();
+    return -1;
+  }
+  PyObject* s = PyUnicode_FromString(path);
+  int rc = PyObject_SetAttrString(cfg, "import_strategy_file", s);
+  Py_DECREF(s);
+  Py_DECREF(cfg);
+  if (rc != 0) {
+    set_err_from_python();
+    return -1;
+  }
+  return 0;
+}
+
+int flexflow_model_export_strategies(flexflow_model_t m, const char* path) {
+  PyObject* mod = PyImport_ImportModule("flexflow_tpu.strategy.proto");
+  if (!mod) {
+    set_err_from_python();
+    return -1;
+  }
+  // {op.name: op.parallel_config for op in m.layers if op.parallel_config}
+  PyObject* strategies = PyDict_New();
+  PyObject* layers = PyObject_GetAttrString(obj(m), "layers");
+  for (Py_ssize_t i = 0; layers && i < PyList_Size(layers); i++) {
+    PyObject* op = PyList_GetItem(layers, i);  // borrowed
+    PyObject* pc = PyObject_GetAttrString(op, "parallel_config");
+    if (pc && pc != Py_None) {
+      PyObject* nm = PyObject_GetAttrString(op, "name");
+      PyDict_SetItem(strategies, nm, pc);
+      Py_DECREF(nm);
+    }
+    Py_XDECREF(pc);
+  }
+  Py_XDECREF(layers);
+  PyObject* r = PyObject_CallMethod(mod, "save_strategy_file", "sO", path,
+                                    strategies);
+  Py_DECREF(strategies);
+  Py_DECREF(mod);
+  if (!r) {
+    set_err_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- checkpoint ---- */
+
+static int ckpt_call(flexflow_model_t m, const char* meth, const char* path) {
+  PyObject* r = PyObject_CallMethod(obj(m), meth, "s", path);
+  if (!r) {
+    set_err_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int flexflow_model_save_checkpoint(flexflow_model_t m, const char* path) {
+  return ckpt_call(m, "save_checkpoint", path);
+}
+
+int flexflow_model_load_checkpoint(flexflow_model_t m, const char* path) {
+  return ckpt_call(m, "load_checkpoint", path);
 }
 
 }  // extern "C"

@@ -1,0 +1,53 @@
+"""CANDLE Uno through the keras functional API (reference
+examples/python/keras/candle_uno/uno.py port): per-feature encoder towers
+with SHARED weights per feature kind, Concatenate, deep trunk, MSE head.
+Shrunk feature widths keep the example fast; pass real dims to match
+candle_uno.h:24-37."""
+
+import numpy as np
+
+from flexflow_tpu import get_default_config
+from flexflow_tpu.keras import Concatenate, Dense, Input, Model, SGD
+
+FEATURE_SHAPES = {"dose": 1, "cell.rnaseq": 60, "drug.descriptors": 80,
+                  "drug.fingerprints": 40}
+INPUT_FEATURES = {"dose1": "dose", "dose2": "dose",
+                  "cell.rnaseq": "cell.rnaseq",
+                  "drug1.descriptors": "drug.descriptors",
+                  "drug1.fingerprints": "drug.fingerprints"}
+
+
+def top_level_task():
+    cfg = get_default_config()
+    towers = {}  # one shared encoder stack per feature KIND (uno.py design)
+    for kind, width in FEATURE_SHAPES.items():
+        if width > 1:
+            towers[kind] = [Dense(32, activation="relu",
+                                  name=f"{kind}_enc_{i}".replace(".", "_"))
+                            for i in range(2)]
+    inputs, encoded = [], []
+    for name, kind in INPUT_FEATURES.items():
+        inp = Input((FEATURE_SHAPES[kind],), name=name.replace(".", "_"))
+        inputs.append(inp)
+        t = inp
+        for layer in towers.get(kind, []):
+            t = layer(t)  # shared weights across same-kind inputs
+        encoded.append(t)
+    t = Concatenate(axis=1)(encoded)
+    for i in range(3):
+        t = Dense(64, activation="relu", name=f"trunk_{i}")(t)
+    out = Dense(1, name="head")(t)
+    model = Model(inputs, out)
+    model.compile(SGD(learning_rate=0.001), loss="mean_squared_error",
+                  metrics=["mean_squared_error"], config=cfg)
+    rng = np.random.default_rng(0)
+    n = 4 * cfg.batch_size
+    xs = [rng.standard_normal(
+        (n, FEATURE_SHAPES[k])).astype(np.float32)
+        for k in INPUT_FEATURES.values()]
+    y = rng.random((n, 1)).astype(np.float32)
+    model.fit(xs, y, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

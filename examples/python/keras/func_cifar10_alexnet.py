@@ -1,12 +1,11 @@
-"""Functional CIFAR-10 CNN (reference
-examples/python/keras/func_cifar10_cnn.py)."""
+"""Functional CIFAR-10 AlexNet (reference
+examples/python/keras/func_cifar10_alexnet.py)."""
 
 import numpy as np
 
 from flexflow_tpu import get_default_config
 from flexflow_tpu.keras import (Activation, Conv2D, Dense, Flatten, Input,
-                                MaxPooling2D, Model, ModelAccuracy, SGD,
-                                VerifyMetrics)
+                                MaxPooling2D, Model, SGD)
 from flexflow_tpu.keras.datasets import cifar10
 
 
@@ -17,22 +16,22 @@ def top_level_task():
     y_train = y_train.reshape(-1, 1).astype(np.int32)
 
     inp = Input((3, 32, 32))
-    t = Conv2D(32, (3, 3), padding="same", activation="relu")(inp)
-    t = Conv2D(32, (3, 3), padding="same", activation="relu")(t)
+    t = Conv2D(64, (11, 11), strides=(4, 4), padding=(5, 5),
+               activation="relu")(inp)
     t = MaxPooling2D((2, 2))(t)
-    t = Conv2D(64, (3, 3), padding="same", activation="relu")(t)
-    t = Conv2D(64, (3, 3), padding="same", activation="relu")(t)
+    t = Conv2D(192, (5, 5), padding=(2, 2), activation="relu")(t)
     t = MaxPooling2D((2, 2))(t)
+    t = Conv2D(256, (3, 3), padding="same", activation="relu")(t)
     t = Flatten()(t)
     t = Dense(512, activation="relu")(t)
+    t = Dense(512, activation="relu")(t)
     out = Activation("softmax")(Dense(10)(t))
-
     model = Model(inp, out)
-    model.compile(SGD(learning_rate=0.05),
+    model.compile(SGD(learning_rate=0.01),
                   loss="sparse_categorical_crossentropy",
                   metrics=["accuracy"], config=cfg)
-    model.fit(x_train, y_train, epochs=cfg.epochs,
-              callbacks=[VerifyMetrics(ModelAccuracy.CIFAR10_CNN)])
+    print(model.summary())
+    model.fit(x_train, y_train, epochs=cfg.epochs)
 
 
 if __name__ == "__main__":

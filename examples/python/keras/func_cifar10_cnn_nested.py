@@ -1,0 +1,39 @@
+"""Nested functional model: a shared conv tower called on two crops
+(reference examples/python/keras/func_cifar10_cnn_nested.py /
+func_cifar10_cnn_concat_model.py — models composed of reused sub-graphs).
+Exercises shared-layer reuse: one weight set, two call sites."""
+
+import numpy as np
+
+from flexflow_tpu import get_default_config
+from flexflow_tpu.keras import (Activation, Add, Conv2D, Dense, Flatten,
+                                Input, MaxPooling2D, Model, SGD)
+from flexflow_tpu.keras.datasets import cifar10
+
+
+def top_level_task():
+    cfg = get_default_config()
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train.astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+
+    a = Input((3, 32, 32))
+    b = Input((3, 32, 32))
+    # ONE tower, called twice -> weights shared across both branches
+    conv = Conv2D(32, (3, 3), padding="same", activation="relu",
+                  name="shared_conv")
+    ta, tb = conv(a), conv(b)
+    t = Add()([ta, tb])
+    t = MaxPooling2D((2, 2))(t)
+    t = Flatten()(t)
+    t = Dense(128, activation="relu")(t)
+    out = Activation("softmax")(Dense(10)(t))
+    model = Model([a, b], out)
+    model.compile(SGD(learning_rate=0.02),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], config=cfg)
+    model.fit([x_train, x_train], y_train, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

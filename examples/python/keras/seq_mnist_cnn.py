@@ -1,0 +1,36 @@
+"""Sequential MNIST CNN (reference examples/python/keras/seq_mnist_cnn.py)."""
+
+import numpy as np
+
+from flexflow_tpu import get_default_config
+from flexflow_tpu.keras import (Activation, Conv2D, Dense, Flatten,
+                                MaxPooling2D, ModelAccuracy, SGD, Sequential,
+                                VerifyMetrics)
+from flexflow_tpu.keras.datasets import mnist
+
+
+def top_level_task():
+    cfg = get_default_config()
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 1, 28, 28).astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+
+    model = Sequential([
+        Conv2D(32, (3, 3), padding="valid", activation="relu",
+               input_shape=(1, 28, 28)),
+        Conv2D(64, (3, 3), padding="valid", activation="relu"),
+        MaxPooling2D((2, 2)),
+        Flatten(),
+        Dense(128, activation="relu"),
+        Dense(10),
+        Activation("softmax"),
+    ])
+    model.compile(SGD(learning_rate=0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], config=cfg)
+    model.fit(x_train, y_train, epochs=cfg.epochs,
+              callbacks=[VerifyMetrics(ModelAccuracy.MNIST_CNN)])
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,37 @@
+"""Reuters newswire MLP (reference
+examples/python/keras/seq_reuters_mlp.py): Tokenizer bag-of-words
+vectorization + Sequential MLP over 46 topics."""
+
+import numpy as np
+
+from flexflow_tpu import get_default_config
+from flexflow_tpu.keras import Activation, Dense, Input, SGD, Sequential
+from flexflow_tpu.keras.datasets import reuters
+from flexflow_tpu.keras.preprocessing.text import Tokenizer
+
+
+def top_level_task():
+    cfg = get_default_config()
+    max_words = 1000
+    (x_train, y_train), _ = reuters.load_data(num_words=max_words,
+                                              test_split=0.2)
+    num_classes = int(np.max(y_train)) + 1
+    print(len(x_train), "train sequences,", num_classes, "classes")
+    tokenizer = Tokenizer(num_words=max_words)
+    x_train = tokenizer.sequences_to_matrix(list(x_train), mode="binary")
+    y_train = np.asarray(y_train).reshape(-1, 1).astype(np.int32)
+
+    model = Sequential([
+        Input((max_words,)),
+        Dense(512, activation="relu"),
+        Dense(num_classes),
+        Activation("softmax"),
+    ])
+    model.compile(SGD(learning_rate=0.05),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], config=cfg)
+    model.fit(x_train, y_train, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

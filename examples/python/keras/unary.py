@@ -1,0 +1,30 @@
+"""Element-unary activation coverage (reference
+examples/python/keras/unary.py): every Activation kind through the keras
+surface in one model."""
+
+import numpy as np
+
+from flexflow_tpu import get_default_config
+from flexflow_tpu.keras import Activation, Dense, Input, Model, SGD
+
+
+def top_level_task():
+    cfg = get_default_config()
+    inp = Input((32,))
+    t = inp
+    for kind in ("relu", "sigmoid", "tanh", "elu", "gelu"):
+        t = Activation(kind)(Dense(32)(t))
+    out = Activation("softmax")(Dense(4)(t))
+    model = Model(inp, out)
+    model.compile(SGD(learning_rate=0.05),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], config=cfg)
+    rng = np.random.default_rng(0)
+    n = 4 * cfg.batch_size
+    y = rng.integers(0, 4, (n, 1)).astype(np.int32)
+    x = rng.standard_normal((n, 32)).astype(np.float32) + 0.5 * y
+    model.fit(x, y, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

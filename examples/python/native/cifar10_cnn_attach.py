@@ -1,0 +1,48 @@
+"""CIFAR-10 CNN with an explicit dataloader + attach-style batches
+(reference examples/python/native/cifar10_cnn_attach.py: numpy attach +
+SingleDataLoader.next_batch round)."""
+
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.data.dataloader import DataLoader
+from flexflow_tpu.keras.datasets import cifar10
+
+
+def top_level_task():
+    cfg = ff.get_default_config()
+    model = ff.FFModel(cfg)
+    x = model.create_tensor((cfg.batch_size, 3, 32, 32), name="img")
+    t = model.conv2d(x, 32, 3, 3, 1, 1, 1, 1, activation="relu")
+    t = model.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = model.conv2d(t, 64, 3, 3, 1, 1, 1, 1, activation="relu")
+    t = model.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = model.flat(t)
+    t = model.dense(t, 128, activation="relu")
+    logits = model.dense(t, 10)
+    model.softmax(logits)
+    model.compile(ff.SGDOptimizer(lr=0.02),
+                  ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [ff.METRICS_ACCURACY], final_tensor=logits)
+    model.init_layers(seed=cfg.seed)
+
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train.astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+    loader = DataLoader(model, [x_train], y_train)
+    iters = x_train.shape[0] // cfg.batch_size
+    for epoch in range(cfg.epochs):
+        loader.reset()
+        model.perf_metrics = ff.PerfMetrics()
+        for _ in range(iters):
+            loader.next_batch(model)   # reference data_loader.next_batch(ff)
+            model.forward()
+            model.zero_gradients()
+            model.backward()
+            model.update()
+        print(f"epoch {epoch}: "
+              f"{model.perf_metrics.report([ff.METRICS_ACCURACY])}")
+
+
+if __name__ == "__main__":
+    top_level_task()

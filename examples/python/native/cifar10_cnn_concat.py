@@ -1,0 +1,36 @@
+"""CIFAR-10 CNN with a concat branch (reference
+examples/python/native/cifar10_cnn_concat.py): two conv towers over the
+same input concatenated on the channel dim."""
+
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.keras.datasets import cifar10
+
+
+def top_level_task():
+    cfg = ff.get_default_config()
+    model = ff.FFModel(cfg)
+    x = model.create_tensor((cfg.batch_size, 3, 32, 32), name="img")
+    t1 = model.conv2d(x, 32, 3, 3, 1, 1, 1, 1, activation="relu")
+    t2 = model.conv2d(x, 32, 5, 5, 1, 1, 2, 2, activation="relu")
+    t = model.concat([t1, t2], axis=1)          # channel concat
+    t = model.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = model.conv2d(t, 64, 3, 3, 1, 1, 1, 1, activation="relu")
+    t = model.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = model.flat(t)
+    t = model.dense(t, 128, activation="relu")
+    logits = model.dense(t, 10)
+    model.softmax(logits)
+    model.compile(ff.SGDOptimizer(lr=0.02),
+                  ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [ff.METRICS_ACCURACY], final_tensor=logits)
+    model.init_layers(seed=cfg.seed)
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train.astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+    model.fit(x_train, y_train, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,28 @@
+"""InceptionV3 through the native FFModel API (reference
+examples/python/native/inception.py; C++ app
+examples/cpp/InceptionV3/inception.cc).  Synthetic data by default.
+Run: flexflow-tpu inception.py -b 16 -e 1"""
+
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.models.inception import build_inception_v3
+
+
+def top_level_task():
+    cfg = ff.get_default_config()
+    model, inp, logits = build_inception_v3(cfg, num_classes=10,
+                                            image_size=299)
+    model.compile(ff.SGDOptimizer(lr=cfg.learning_rate),
+                  ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [ff.METRICS_ACCURACY], final_tensor=logits)
+    model.init_layers(seed=cfg.seed)
+    rng = np.random.default_rng(0)
+    n = 2 * cfg.batch_size
+    x = rng.standard_normal((n, 3, 299, 299), dtype=np.float32)
+    y = rng.integers(0, 10, (n, 1)).astype(np.int32)
+    model.fit(x, y, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,45 @@
+"""MNIST MLP driving the numpy-attach path (reference
+examples/python/native/mnist_mlp_attach.py): instead of fit(), host numpy
+buffers are attached per iteration via ``set_batch`` (the reference's
+``attach_raw_ptr``/inline-map round, model.cc:73-86) and the training verbs
+are issued manually."""
+
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.keras.datasets import mnist
+
+
+def top_level_task():
+    cfg = ff.get_default_config()
+    model = ff.FFModel(cfg)
+    x = model.create_tensor((cfg.batch_size, 784), name="input")
+    t = model.dense(x, 128, activation="relu")
+    logits = model.dense(t, 10)
+    model.softmax(logits)
+    model.compile(ff.SGDOptimizer(lr=0.05),
+                  ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [ff.METRICS_ACCURACY], final_tensor=logits)
+    model.init_layers(seed=cfg.seed)
+
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 784).astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+    bs = cfg.batch_size
+    iters = x_train.shape[0] // bs
+    for epoch in range(cfg.epochs):
+        model.perf_metrics = ff.PerfMetrics()
+        for it in range(iters):
+            lo = it * bs
+            # attach the next host window and run the verb sequence
+            model.set_batch(x_train[lo:lo + bs], y_train[lo:lo + bs])
+            model.forward()
+            model.zero_gradients()
+            model.backward()
+            model.update()
+        print(f"epoch {epoch}: "
+              f"{model.perf_metrics.report([ff.METRICS_ACCURACY])}")
+
+
+if __name__ == "__main__":
+    top_level_task()

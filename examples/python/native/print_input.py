@@ -1,0 +1,43 @@
+"""Dump inputs and first-layer activations for manual diffing (reference
+examples/python/native/print_input.py: inline-maps input regions and
+prints them; numerical-comparison scaffolding, SURVEY §4)."""
+
+import numpy as np
+
+import flexflow_tpu as ff
+
+
+def top_level_task():
+    cfg = ff.get_default_config()
+    model = ff.FFModel(cfg)
+    img = model.create_tensor((cfg.batch_size, 3, 32, 32), name="img")
+    vec = model.create_tensor((cfg.batch_size, 256), name="vec")
+    c = model.conv2d(img, 16, 3, 3, 1, 1, 1, 1, name="conv1")
+    c = model.flat(c)
+    d = model.dense(vec, 128, activation="relu", name="fc1")
+    t = model.concat([c, d], axis=1)
+    logits = model.dense(t, 10, name="head")
+    model.compile(ff.SGDOptimizer(lr=0.01),
+                  ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  final_tensor=logits)
+    model.init_layers(seed=0)
+
+    rng = np.random.default_rng(0)
+    xb = [rng.standard_normal((cfg.batch_size, 3, 32, 32),
+                              dtype=np.float32),
+          np.full((cfg.batch_size, 256), 2.2, np.float32)]
+    yb = np.zeros((cfg.batch_size, 1), np.int32)
+    model.set_batch(*xb, yb)
+    for name, arr in zip(("img", "vec"), xb):
+        print(f"input {name}: shape {arr.shape}")
+        print(arr.reshape(arr.shape[0], -1)[:2, :8])
+    logits_val = np.asarray(model.forward())
+    print(f"logits: shape {logits_val.shape}")
+    print(logits_val[:2])
+    w = model.get_weights("conv1/kernel")
+    print(f"conv1/kernel: shape {w.shape} mean {w.mean():+.6f} "
+          f"std {w.std():.6f}")
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,28 @@
+"""ResNet-50 through the native FFModel API (reference
+examples/python/native/resnet.py; C++ app examples/cpp/ResNet/resnet.cc).
+Synthetic data by default, like the reference with ``-d`` unset
+(README.md:44).  Run: flexflow-tpu resnet.py -b 32 -e 1"""
+
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.models.resnet import build_resnet50
+
+
+def top_level_task():
+    cfg = ff.get_default_config()
+    # small image/classes keep the example fast; pass --budget etc. as usual
+    model, inp, logits = build_resnet50(cfg, num_classes=10, image_size=64)
+    model.compile(ff.SGDOptimizer(lr=cfg.learning_rate),
+                  ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [ff.METRICS_ACCURACY], final_tensor=logits)
+    model.init_layers(seed=cfg.seed)
+    rng = np.random.default_rng(0)
+    n = 4 * cfg.batch_size
+    x = rng.standard_normal((n,) + inp.shape[1:], dtype=np.float32)
+    y = rng.integers(0, 10, (n, 1)).astype(np.int32)
+    model.fit(x, y, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

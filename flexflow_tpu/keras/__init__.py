@@ -3,7 +3,7 @@
 layer/optimizer/callback surfaces, and the accuracy-verification callbacks
 the reference's example suite uses as its test harness."""
 
-from . import callbacks, datasets, layers, optimizers
+from . import callbacks, datasets, layers, optimizers, preprocessing
 from .callbacks import (Callback, EpochVerifyMetrics, LearningRateScheduler,
                         ModelAccuracy, VerifyMetrics)
 from .layers import (Activation, Add, AveragePooling2D, BatchNormalization,

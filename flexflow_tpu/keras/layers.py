@@ -16,14 +16,18 @@ from typing import List, Optional, Sequence, Tuple, Union
 
 
 class KerasTensor:
-    """Symbolic tensor: shape EXCLUDES the batch dim (keras convention)."""
+    """Symbolic tensor: shape EXCLUDES the batch dim (keras convention).
+    ``inbound`` records the inputs of the call that produced it, so a layer
+    called more than once (shared weights) yields one graph node per call."""
 
     def __init__(self, shape: Tuple[int, ...], dtype: str = "float32",
-                 producer: Optional["Layer"] = None, index: int = 0):
+                 producer: Optional["Layer"] = None, index: int = 0,
+                 inbound: Optional[List["KerasTensor"]] = None):
         self.shape = tuple(shape)
         self.dtype = dtype
         self.producer = producer
         self.index = index
+        self.inbound: List["KerasTensor"] = list(inbound or [])
 
     def __repr__(self):
         return f"KerasTensor(shape={self.shape}, dtype={self.dtype})"
@@ -47,10 +51,13 @@ class Layer:
     # --- graph recording -------------------------------------------------
     def __call__(self, inputs):
         ins = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
-        self.inbound = ins
         out_shapes = self.compute_output_shape([t.shape for t in ins])
-        self.output = KerasTensor(out_shapes, self.output_dtype(ins), self)
-        return self.output
+        out = KerasTensor(out_shapes, self.output_dtype(ins), self,
+                          inbound=ins)
+        if not self.inbound:  # first call: keep legacy attributes
+            self.inbound = ins
+            self.output = out
+        return out
 
     def output_dtype(self, ins: List[KerasTensor]) -> str:
         return ins[0].dtype

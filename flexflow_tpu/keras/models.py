@@ -46,24 +46,29 @@ class BaseModel:
         self._compiled = False
 
     # ---- graph -> FFModel ----------------------------------------------
-    def _topo_layers(self, outputs: List[KerasTensor]) -> List[Layer]:
-        seen: List[Layer] = []
+    def _topo_tensors(self, outputs: List[KerasTensor]) -> List[KerasTensor]:
+        """Per-call tensor nodes in topological order (a layer called N
+        times contributes N nodes — shared-layer reuse)."""
+        order: List[KerasTensor] = []
+        seen: set = set()
 
         def visit(t: KerasTensor):
-            layer = t.producer
-            if layer is None or layer in seen:
+            if id(t) in seen:
                 return
-            if not isinstance(layer, InputLayer) and layer.output is not t:
-                raise ValueError(
-                    f"layer {layer.name!r} was called more than once; "
-                    f"shared-layer reuse is not supported — instantiate a "
-                    f"separate layer per call")
-            for src in layer.inbound:
+            seen.add(id(t))
+            for src in t.inbound:
                 visit(src)
-            seen.append(layer)
+            order.append(t)
 
         for t in outputs:
             visit(t)
+        return order
+
+    def _topo_layers(self, outputs: List[KerasTensor]) -> List[Layer]:
+        seen: List[Layer] = []
+        for t in self._topo_tensors(outputs):
+            if t.producer is not None and t.producer not in seen:
+                seen.append(t.producer)
         return seen
 
     def _build_ff(self, inputs: List[KerasTensor],
@@ -77,12 +82,30 @@ class BaseModel:
             values[id(kt)] = ff.create_tensor(
                 (config.batch_size,) + kt.shape, dtype=kt.dtype,
                 name=layer.name)
-        for layer in self._topo_layers(outputs):
-            if isinstance(layer, InputLayer):
+        # layer -> (first core op, #calls emitted): later calls of the same
+        # layer emit a fresh op whose weights alias the first call's
+        # (reference keras graph model shares one weight region per layer)
+        emitted: Dict[Layer, list] = {}
+        for kt in self._topo_tensors(outputs):
+            layer = kt.producer
+            if layer is None or isinstance(layer, InputLayer) \
+                    or id(kt) in values:
                 continue
-            in_ts = [values[id(t)] for t in layer.inbound]
-            out = layer.build_ff(ff, in_ts)
-            values[id(layer.output)] = out
+            in_ts = [values[id(t)] for t in kt.inbound]
+            if layer in emitted:
+                first_op, calls = emitted[layer]
+                orig = layer.name
+                layer.name = f"{orig}__shared{calls}"
+                try:
+                    out = layer.build_ff(ff, in_ts)
+                finally:
+                    layer.name = orig
+                ff.share_weights(out.owner_op, first_op)
+                emitted[layer][1] += 1
+            else:
+                out = layer.build_ff(ff, in_ts)
+                emitted[layer] = [out.owner_op, 1]
+            values[id(kt)] = out
             layer._core_model = ff
         self.ffmodel = ff
         self._ff_outputs = [values[id(t)] for t in outputs]
@@ -184,6 +207,9 @@ class Sequential(BaseModel):
 
     def _build_graph(self):
         first = self._stack[0]
+        if isinstance(first, KerasTensor):  # Sequential([Input(...), ...])
+            first = first.producer
+            self._stack[0] = first
         if isinstance(first, InputLayer):
             t = first.output
             stack = self._stack[1:]

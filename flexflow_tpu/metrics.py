@@ -37,9 +37,12 @@ class PerfMetrics:
     mse_loss: float = 0.0
     rmse_loss: float = 0.0
     mae_loss: float = 0.0
+    has_accuracy: bool = False  # accuracy metric enabled (vs value 0)
 
     def update(self, batch_sums: Dict[str, jax.Array]) -> None:
         self.train_all += int(batch_sums.get("count", 0))
+        if "correct" in batch_sums:
+            self.has_accuracy = True
         self.train_correct += int(batch_sums.get("correct", 0))
         self.cce_loss += float(batch_sums.get("cce", 0.0))
         self.sparse_cce_loss += float(batch_sums.get("scce", 0.0))
@@ -56,7 +59,7 @@ class PerfMetrics:
         the structured per-epoch log event (fflogger)."""
         n = max(1, self.train_all)
         out: Dict[str, float] = {"samples_seen": float(self.train_all)}
-        if self.train_correct:
+        if self.has_accuracy:  # 0% accuracy is a value, not "disabled"
             out["accuracy"] = self.accuracy
         for k, v in (("cce", self.cce_loss), ("scce", self.sparse_cce_loss),
                      ("mse", self.mse_loss), ("rmse", self.rmse_loss),

@@ -596,7 +596,7 @@ class FFModel:
                         pc = lop.parallel_config
                         break
                 spec = param_spec(p, pc, self.mesh)
-                val = jax.device_put(val, self.mesh.sharding(spec))
+                val = self._put_global(val, self.mesh.sharding(spec))
             params[p.name] = val
         self._params = params
         trainable = {}
@@ -609,6 +609,23 @@ class FFModel:
             trainable[k] = v
         self._opt_state = self.optimizer.init_state(trainable)
         self._step = 0
+
+    def share_weights(self, op: Op, source_op: Op) -> None:
+        """Make ``op`` read ``source_op``'s parameters — keras shared-layer
+        reuse (the reference's graph model re-uses one weight region across
+        calls; here two ops reference the same Parameter objects, so the
+        params dict holds one entry and autodiff sums both call sites'
+        gradients automatically)."""
+        assert len(op.weights) == len(source_op.weights), \
+            (op.name, source_op.name)
+        for w_new, w_old in zip(list(op.weights), source_op.weights):
+            assert tuple(w_new.shape) == tuple(w_old.shape), \
+                (w_new.name, w_new.shape, w_old.shape)
+            for attr, val in list(vars(op).items()):
+                if val is w_new:
+                    setattr(op, attr, w_old)
+            self.parameters = [p for p in self.parameters if p is not w_new]
+        op.weights = list(source_op.weights)
 
     def get_parameter_by_name(self, name: str) -> Optional[Parameter]:
         for p in self.parameters:
@@ -625,13 +642,27 @@ class FFModel:
         cur = self._params[key]
         val = jnp.asarray(value, cur.dtype).reshape(cur.shape)
         if self.mesh is not None and self.mesh.is_distributed:
-            val = jax.device_put(val, cur.sharding)
+            val = self._put_global(val, cur.sharding)
         self._params[key] = val
 
     # ------------------------------------------------------------------
     # checkpoint / resume (beyond the reference: it persists nothing but
     # strategy files — SURVEY §5 "no model checkpointing")
     # ------------------------------------------------------------------
+    @staticmethod
+    def _put_global(val, sharding):
+        """Place a host-resident full array under ``sharding``.  In
+        multi-process runs a sharding spanning non-addressable devices
+        cannot be device_put directly; each process contributes its
+        addressable shards instead (every process holds the same full
+        value — deterministic init/feeds), the multi-controller SPMD
+        contract of the reference's GASNet path (FlexFlow.mk:68-69)."""
+        if jax.process_count() > 1 and not sharding.is_fully_addressable:
+            arr = np.asarray(val)
+            return jax.make_array_from_callback(
+                arr.shape, sharding, lambda idx: arr[idx])
+        return jax.device_put(val, sharding)
+
     @staticmethod
     def _gather_host(v) -> np.ndarray:
         """Fetch an array to host numpy, allgathering across processes for
@@ -706,12 +737,12 @@ class FFModel:
                         f"{f[f'opt:{i}'].shape} != {tuple(leaf.shape)}")
             for name in ckpt_params:
                 cur = self._params[name]
-                val = jnp.asarray(f[f"param:{name}"], cur.dtype)
-                self._params[name] = jax.device_put(val, cur.sharding)
+                val = np.asarray(f[f"param:{name}"]).astype(cur.dtype)
+                self._params[name] = self._put_global(val, cur.sharding)
             new_leaves = []
             for i, leaf in enumerate(leaves):
-                arr = jnp.asarray(f[f"opt:{i}"], leaf.dtype)
-                new_leaves.append(jax.device_put(arr, leaf.sharding))
+                arr = np.asarray(f[f"opt:{i}"]).astype(leaf.dtype)
+                new_leaves.append(self._put_global(arr, leaf.sharding))
             self._opt_state = jax.tree_util.tree_unflatten(treedef,
                                                            new_leaves)
             self._step = int(f["meta:step"])
@@ -748,7 +779,7 @@ class FFModel:
                 entries = [ax if ax is None or
                            a.shape[i] % self.mesh.axis_size(ax) == 0 else None
                            for i, ax in enumerate(spec)]
-                a = jax.device_put(
+                a = self._put_global(
                     a, self.mesh.sharding(jax.sharding.PartitionSpec(*entries)))
             out.append(a)
         return out

@@ -96,7 +96,8 @@ def op_compute_time(op: Op, part_degrees: Tuple[int, ...],
 
 def op_memory_bytes(op: Op, part_degrees: Tuple[int, ...],
                     dtype_bytes: int = 2, opt_slot_bytes: int = 4,
-                    axes: Tuple[str, ...] = (), num_devices: int = 1) -> float:
+                    axes: Tuple[str, ...] = (),
+                    stack_degrees: Dict[str, int] | None = None) -> float:
     """Per-chip resident bytes one op contributes to the training step's
     high-water mark (reference: the simulator allocates its scratch from
     real FB memory, simulator.cu:82-88, so unfittable strategies are
@@ -105,13 +106,15 @@ def op_memory_bytes(op: Op, part_degrees: Tuple[int, ...],
     * parameters + their gradients (f32) + optimizer slots, sharded over
       the ``c`` (channel/TP) degrees when the weight declares a
       ``sharded_dim``, replicated otherwise;
-    * expert-/stage-stacked weights (``shard_axis`` 'e'/'p') are assumed
-      sharded over their dedicated mesh axis at its designed size
-      ``min(stack_extent, num_devices)`` — that axis is why the weight
-      declares the attribute, and the SOAP search never sizes e/p itself;
+    * expert-/stage-stacked weights (``shard_axis`` 'e'/'p') shard over
+      their dedicated mesh axis at the size given in ``stack_degrees``
+      ({"e": ..., "p": ...}); absent/1 means REPLICATED — the
+      conservative truth on meshes that do not raise those axes (the
+      SOAP search's candidate meshes pin e=p=1);
     * the op's output activations (retained for backward), divided over
       ALL partition degrees.
     """
+    stack_degrees = stack_degrees or {}
     c_deg = 1
     for deg, ax in zip(part_degrees, axes):
         if ax == "c":
@@ -124,7 +127,8 @@ def op_memory_bytes(op: Op, part_degrees: Tuple[int, ...],
         per_param = w.volume * (4.0 * 2 + opt_slot_bytes)  # + grad + slots
         stack_ax = getattr(w, "shard_axis", "c")
         if stack_ax in ("e", "p") and w.sharded_dim is not None:
-            per_param /= max(1, min(w.shape[w.sharded_dim], num_devices))
+            deg = stack_degrees.get(stack_ax, 1)
+            per_param /= max(1, min(w.shape[w.sharded_dim], deg))
         elif (w.sharded_dim is not None and c_deg > 1
                 and w.shape[w.sharded_dim] % c_deg == 0):
             per_param /= c_deg

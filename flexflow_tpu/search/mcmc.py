@@ -180,7 +180,8 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
         deg = max((d for d in expressible_degrees(num_devices)
                    if op.outputs[0].shape[0] % d == 0), default=1)
         current[op.name] = ParallelConfig.data_parallel(deg, nd)
-    cur_time = sim.simulate(layers, current, overlap_backward_update)
+    cur_time = sim.simulate(layers, current, overlap_backward_update,
+                            mesh_shape=mesh_shape)
     best, best_mesh, best_time = dict(current), dict(mesh_shape), cur_time
     for it in range(budget):
         if len(meshes) > 1 and rng.random() < 0.1:
@@ -202,7 +203,8 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
             proposal = dict(current)
             proposal[op.name] = new_cfg
             prop_mesh = mesh_shape
-        new_time = sim.simulate(layers, proposal, overlap_backward_update)
+        new_time = sim.simulate(layers, proposal, overlap_backward_update,
+                                mesh_shape=prop_mesh)
         delta = new_time - cur_time
         # inf -> inf moves are accepted unconditionally: when the start
         # point is infeasible (e.g. DP blows the HBM budget) the walk must

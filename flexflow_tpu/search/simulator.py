@@ -25,9 +25,8 @@ import numpy as np
 from ..config import FFConfig, ParallelConfig
 from ..op import Op
 from ..tensor import Tensor
-from .cost_model import (DEFAULT_SPEC, DeviceSpec, allreduce_time,
-                         op_compute_time, op_memory_bytes, spec_for_device,
-                         transfer_time)
+from .cost_model import (DeviceSpec, allreduce_time, op_compute_time,
+                         op_memory_bytes, spec_for_device, transfer_time)
 
 
 class SimTask:
@@ -185,12 +184,17 @@ class Simulator:
         return pc, dims, ft, bt, sync
 
     def peak_memory_bytes(self, layers: List[Op],
-                          strategies: Dict[str, ParallelConfig]) -> float:
+                          strategies: Dict[str, ParallelConfig],
+                          mesh_shape: Optional[Dict[str, int]] = None
+                          ) -> float:
         """Per-chip HBM high-water estimate for a strategy: params + grads +
         optimizer slots (sharded over TP degrees) + retained activations
-        (sharded over all degrees).  The reference grounds legality in real
-        FB memory (simulator.cu:82-88); this is the explicit TPU analogue."""
+        (sharded over all degrees).  ``mesh_shape`` supplies the e/p axis
+        sizes for expert-/stage-stacked weights (absent -> replicated).
+        The reference grounds legality in real FB memory
+        (simulator.cu:82-88); this is the explicit TPU analogue."""
         from ..parallel.mesh import dim_axis_names
+        stack = {a: (mesh_shape or {}).get(a, 1) for a in ("e", "p")}
         total = 0.0
         for op in layers:
             pc = strategies.get(op.name)
@@ -203,7 +207,7 @@ class Simulator:
                     (1,) * max(0, out.num_dims - len(pc.dims))
             total += op_memory_bytes(op, dims, self.dtype_bytes,
                                      axes=dim_axis_names(out.num_dims),
-                                     num_devices=self.num_devices)
+                                     stack_degrees=stack)
         return total
 
     def _simulate_native(self, layers: List[Op],
@@ -271,14 +275,16 @@ class Simulator:
 
     def simulate(self, layers: List[Op],
                  strategies: Dict[str, ParallelConfig],
-                 overlap_backward_update: bool = False) -> float:
+                 overlap_backward_update: bool = False,
+                 mesh_shape: Optional[Dict[str, int]] = None) -> float:
         """Simulated per-iteration runtime (seconds) — the MCMC objective
         (reference simulate_runtime, simulator.cc:275-448).  Strategies whose
         per-chip memory exceeds the spec's HBM capacity are unrunnable and
         score inf (reference: simulator scratch comes from real FB memory,
         simulator.cu:82-88).  Runs the C++ engine when available
         (native/simulator.cpp), else pure Python."""
-        if self.peak_memory_bytes(layers, strategies) > self.spec.hbm_capacity:
+        if self.peak_memory_bytes(layers, strategies,
+                                  mesh_shape) > self.spec.hbm_capacity:
             return float("inf")
         if self._native is not None:
             t = self._simulate_native(layers, strategies,

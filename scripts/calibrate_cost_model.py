@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Calibrate the analytic roofline cost model against the real chip.
+
+VERDICT round-2 ask #3: the MCMC objective is only as good as the cost
+model, so this script measures ``>= 10`` representative op sub-shapes on
+the attached device (the reference's measure-mode design,
+src/runtime/simulator.cc:235-273) and compares them with
+``op_compute_time`` under the auto-selected ``DeviceSpec``
+(cost_model.spec_for_device).  It reports per-op analytic vs measured
+times and the Pearson correlation of log-times — the number that matters
+for MCMC, which only needs the *ranking* of strategies to be right.
+
+Run on the bench chip:   python scripts/calibrate_cost_model.py
+Results are recorded in BASELINE.md ("Cost-model calibration").
+"""
+
+import math
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.profiling import profile_op
+from flexflow_tpu.search.cost_model import op_compute_time, spec_for_device
+
+
+def build_ops():
+    """A spread of shapes from the five BASELINE workloads."""
+    cfg = ff.FFConfig(batch_size=128, compute_dtype="bfloat16")
+    m = ff.FFModel(cfg, mesh=ff.MachineMesh({"n": 1}))
+    # conv shapes from alexnet/inception/resnet50
+    img = m.create_tensor((128, 3, 224, 224), name="img224")
+    m.conv2d(img, 64, 7, 7, 2, 2, 3, 3, name="conv7x7_s2")       # resnet stem
+    mid = m.create_tensor((128, 256, 35, 35), name="mid35")
+    m.conv2d(mid, 64, 1, 1, 1, 1, 0, 0, name="conv1x1")          # inception
+    m.conv2d(mid, 96, 3, 3, 1, 1, 1, 1, name="conv3x3")
+    deep = m.create_tensor((128, 512, 14, 14), name="deep14")
+    m.conv2d(deep, 512, 3, 3, 1, 1, 1, 1, name="conv3x3_deep")
+    m.pool2d(deep, 2, 2, 2, 2, 0, 0, name="pool2x2")
+    m.batch_norm(mid, name="bn35")
+    # linear shapes from alexnet classifier / nmt vocab projection
+    fc_in = m.create_tensor((128, 9216), name="fc_in")
+    m.dense(fc_in, 4096, name="fc9216x4096")
+    seq = m.create_tensor((128, 24, 2048), name="seq2048")
+    m.dense(seq, 20000, name="vocab_proj")                        # nmt
+    m.lstm(seq, 2048, name="lstm2048")                            # nmt cell
+    # transformer shapes
+    tseq = m.create_tensor((32, 512, 768), name="tseq768")
+    m.multihead_attention(tseq, embed_dim=768, num_heads=12, name="attn768")
+    m.dense(tseq, 3072, activation="gelu", name="ffn_up768")
+    m.softmax(m.create_tensor((128, 1000), name="logits"), name="softmax1k")
+    # embedding (dlrm)
+    ids = m.create_tensor((128, 1), dtype="int32", name="ids")
+    m.embedding(ids, 100000, 64, name="dlrm_table")
+    return m.layers
+
+
+def main():
+    import jax
+    kind = jax.devices()[0].device_kind
+    spec = spec_for_device(kind)
+    print(f"device: {kind}; spec mxu={spec.mxu_flops/1e12:.0f}TF "
+          f"hbm={spec.hbm_bw/1e9:.0f}GB/s", flush=True)
+    rows = []
+    nd_full = lambda op: (1,) * op.outputs[0].num_dims  # noqa: E731
+    for op in build_ops():
+        meas = profile_op(op, "bfloat16", warmup=2, iters=8)
+        ana_f = op_compute_time(op, nd_full(op), spec, backward=False)
+        ana_b = op_compute_time(op, nd_full(op), spec, backward=True)
+        rows.append((op.name, ana_f * 1e3, meas["fwd_ms"],
+                     (ana_f + ana_b) * 1e3,
+                     meas["fwd_ms"] + meas["bwd_ms"]))
+        print(f"{op.name:18s} fwd: analytic {ana_f*1e3:8.3f}ms "
+              f"measured {meas['fwd_ms']:8.3f}ms   fwd+bwd: analytic "
+              f"{(ana_f+ana_b)*1e3:8.3f}ms measured "
+              f"{meas['fwd_ms']+meas['bwd_ms']:8.3f}ms", flush=True)
+    a = np.log([max(1e-7, r[3]) for r in rows])
+    b = np.log([max(1e-7, r[4]) for r in rows])
+    corr = float(np.corrcoef(a, b)[0, 1])
+    ratio = [r[3] / max(1e-9, r[4]) for r in rows]
+    gm = math.exp(float(np.mean(np.log(ratio))))
+    print(f"\nlog-time Pearson correlation (fwd+bwd, n={len(rows)}): "
+          f"{corr:.3f}")
+    print(f"geometric-mean analytic/measured ratio: {gm:.2f}x")
+    import json
+    print(json.dumps({"device_kind": kind, "n_ops": len(rows),
+                      "log_corr": round(corr, 4),
+                      "geomean_ratio": round(gm, 3)}))
+
+
+if __name__ == "__main__":
+    main()

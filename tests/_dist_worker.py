@@ -1,0 +1,67 @@
+"""Worker for the 2-process distributed test (launched by
+tests/test_distributed.py).  Each process owns 4 virtual CPU devices; the
+global mesh spans all 8 (the reference's GASNet multi-node shape,
+FlexFlow.mk:68-69, run as multi-controller SPMD).
+
+argv: <coordinator_port> <process_id> <num_processes> <workdir>
+Writes "<workdir>/loss_<pid>.txt" with the pre-checkpoint and
+post-restore losses.
+"""
+
+import os
+import sys
+
+port, pid, nprocs, workdir = (sys.argv[1], int(sys.argv[2]),
+                              int(sys.argv[3]), sys.argv[4])
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from flexflow_tpu.parallel.distributed import initialize_distributed  # noqa: E402
+
+assert initialize_distributed(coordinator_address=f"localhost:{port}",
+                              num_processes=nprocs, process_id=pid)
+assert jax.process_count() == nprocs, jax.process_count()
+assert len(jax.devices()) == 4 * nprocs, len(jax.devices())
+
+import numpy as np  # noqa: E402
+
+import flexflow_tpu as ff  # noqa: E402
+
+BATCH = 32
+cfg = ff.FFConfig(batch_size=BATCH, compute_dtype="float32")
+model = ff.FFModel(cfg, mesh=ff.MachineMesh({"n": 4, "c": 2}))
+x = model.create_tensor((BATCH, 16), name="x")
+t = model.dense(x, 32, activation="relu", name="fc1")
+t = model.dense(t, 4, name="fc2")
+model.compile(ff.SGDOptimizer(lr=0.1, momentum=0.9),
+              ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY, ["accuracy"],
+              final_tensor=t)
+model.init_layers(seed=0)
+
+rng = np.random.default_rng(0)  # same feed on every process (SPMD)
+xd = rng.standard_normal((BATCH, 16)).astype(np.float32)
+yd = rng.integers(0, 4, (BATCH, 1)).astype(np.int32)
+
+for _ in range(3):
+    loss = float(model.train_batch(xd, yd))
+
+ckpt = os.path.join(workdir, "dist_ckpt")
+model.save_checkpoint(ckpt)  # proc 0 writes; all procs barrier
+
+# keep training, then restore: the post-restore step must reproduce the
+# step right after the save
+loss_after_save = float(model.train_batch(xd, yd))
+for _ in range(2):
+    model.train_batch(xd, yd)
+model.load_checkpoint(ckpt)
+loss_after_restore = float(model.train_batch(xd, yd))
+
+with open(os.path.join(workdir, f"loss_{pid}.txt"), "w") as f:
+    f.write(f"{loss} {loss_after_save} {loss_after_restore}\n")
+print(f"proc {pid}: loss={loss:.6f} resume_delta="
+      f"{abs(loss_after_save - loss_after_restore):.2e}")

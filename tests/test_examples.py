@@ -40,15 +40,60 @@ def test_candle_uno_trains():
     assert losses[-1] < losses[0]
 
 
+def _run_example(script, *extra):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["FLEXFLOW_PLATFORM"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-m", "flexflow_tpu.cli", os.path.join(REPO, script),
+         *extra],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600)
+    assert out.returncode == 0, (out.stdout[-1000:], out.stderr[-2000:])
+    return out
+
+
 @pytest.mark.parametrize("script", [
     "examples/python/native/mnist_mlp.py",
     "examples/python/native/print_layers.py",
+    "examples/python/native/mnist_mlp_attach.py",
+    "examples/python/native/tensor_attach.py",
+    "examples/python/native/print_input.py",
+    "examples/python/native/alexnet_torch.py",
 ])
-def test_example_scripts_run(script):
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    out = subprocess.run(
-        [sys.executable, "-m", "flexflow_tpu.cli", os.path.join(REPO, script),
-         "-b", "32", "-e", "1"],
-        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
-    assert out.returncode == 0, out.stderr[-2000:]
+def test_native_example_scripts_run(script):
+    _run_example(script, "-b", "32", "-e", "1")
+
+
+@pytest.mark.parametrize("script", [
+    "examples/python/keras/seq_mnist_mlp.py",
+    "examples/python/keras/unary.py",
+    "examples/python/keras/func_mnist_mlp_concat.py",
+    "examples/python/keras/seq_reuters_mlp.py",
+    "examples/python/keras/candle_uno_keras.py",
+])
+def test_keras_example_scripts_run(script):
+    _run_example(script, "-b", "64", "-e", "2")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("script", [
+    "examples/python/native/cifar10_cnn.py",
+    "examples/python/native/cifar10_cnn_attach.py",
+    "examples/python/native/cifar10_cnn_concat.py",
+    "examples/python/native/mnist_cnn.py",
+    "examples/python/keras/func_cifar10_cnn.py",
+    "examples/python/keras/seq_mnist_cnn.py",
+    "examples/python/keras/func_cifar10_cnn_nested.py",
+    "examples/python/keras/func_cifar10_alexnet.py",
+])
+def test_cnn_example_scripts_run(script):
+    _run_example(script, "-b", "64", "-e", "4")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("script", [
+    "examples/python/native/resnet.py",
+    "examples/python/native/inception.py",
+])
+def test_big_model_example_scripts_run(script):
+    _run_example(script, "-b", "8", "-e", "1")

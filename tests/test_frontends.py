@@ -161,16 +161,19 @@ def test_load_numpy_dataset_keras_layout(tmp_path):
     assert y.shape == (60,) and y[0] == 1.0
 
 
-def test_shared_layer_reuse_raises():
-    d = Dense(4)
+def test_shared_layer_two_outputs_compiles():
+    # reuse across two inputs with two outputs builds (weights shared);
+    # full numerics covered by test_keras_shared_layer_reuse below
+    d = Dense(4, name="d_two_out")
     a, b = Input((8,)), Input((8,))
     y1 = d(a)
     y2 = d(b)
-    with pytest.raises(ValueError, match="more than once"):
-        Model([a, b], [y1, y2]).compile(
-            keras.SGD(), loss="sparse_categorical_crossentropy",
-            metrics=["accuracy"],
-            config=ff.FFConfig(batch_size=8, compute_dtype="float32"))
+    m = Model([a, b], [y1, y2])
+    m.compile(keras.SGD(), loss="sparse_categorical_crossentropy",
+              metrics=["accuracy"],
+              config=ff.FFConfig(batch_size=8, compute_dtype="float32"))
+    kernels = [p for p in m.ffmodel.parameters if p.name.endswith("kernel")]
+    assert len(kernels) == 1
 
 
 def test_frontends_use_cli_default_config():
@@ -226,3 +229,40 @@ def test_torch_module_alexnet_style():
     assert losses[-1] < losses[0]
     preds = net.predict(x[:32])
     assert preds.shape == (32, 4)
+
+
+def test_keras_shared_layer_reuse():
+    """VERDICT Missing#4: one Layer called twice shares ONE weight set
+    (reference keras graph model semantics) — both branches see identical
+    transforms and training updates the single shared kernel."""
+    import numpy as np
+
+    import flexflow_tpu as ff
+    from flexflow_tpu.keras import Dense, Input, Model, Subtract
+    from flexflow_tpu.keras.optimizers import SGD
+
+    cfg = ff.FFConfig(batch_size=8, compute_dtype="float32")
+    a = Input((16,))
+    b = Input((16,))
+    shared = Dense(8, use_bias=False, name="shared_fc")
+    ya, yb = shared(a), shared(b)
+    out = Subtract()([ya, yb])
+    model = Model([a, b], out)
+    model.compile(SGD(learning_rate=0.05), loss="mean_squared_error",
+                  config=cfg)
+    core = model.ffmodel
+    # exactly ONE kernel parameter despite two call sites
+    kernels = [p for p in core.parameters if p.name.endswith("kernel")]
+    assert len(kernels) == 1, [p.name for p in core.parameters]
+    # same input through both branches -> exactly zero difference
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 16)).astype(np.float32)
+    pred = core.predict([x, x], batch_size=8)
+    np.testing.assert_allclose(pred, np.zeros_like(pred), atol=1e-6)
+    # training through both branches updates the one shared kernel
+    before = core.get_weights("shared_fc/kernel").copy()
+    x2 = rng.standard_normal((8, 16)).astype(np.float32)
+    y = rng.standard_normal((8, 8)).astype(np.float32)
+    loss = float(core.train_batch(x, x2, y))
+    assert np.isfinite(loss)
+    assert np.abs(core.get_weights("shared_fc/kernel") - before).max() > 0
