@@ -98,10 +98,15 @@ def build(model_name: str, batch_size: int):
     return model, (x,), y
 
 
+# best measured per-chip batch size per workload (v5e, BASELINE.md)
+DEFAULT_BATCH = {"inception_v3": 128, "alexnet": 512, "resnet50": 128,
+                 "transformer": 32, "nmt": 256}
+
+
 def main():
     # the BASELINE north-star workload
     model_name = "inception_v3"
-    batch_size = 128
+    batch_size = 0
     iters = 20
     for i, a in enumerate(sys.argv):
         if a == "--model":
@@ -110,6 +115,7 @@ def main():
             batch_size = int(sys.argv[i + 1])
         if a == "--iters":
             iters = int(sys.argv[i + 1])
+    batch_size = batch_size or DEFAULT_BATCH.get(model_name, 128)
     model, xs, y = build(model_name, batch_size)
 
     import jax

@@ -255,6 +255,19 @@ int flexflow_config_get_workers_per_node(flexflow_config_t c) {
   return get_int_attr(c, "workers_per_node");
 }
 
+const char* flexflow_config_get_dataset_path(flexflow_config_t c) {
+  static std::string path;  // lifetime: until next call (C-string handoff)
+  PyObject* v = PyObject_GetAttrString(obj(c), "dataset_path");
+  if (!v) {
+    set_err_from_python();
+    return "";
+  }
+  const char* s = PyUnicode_AsUTF8(v);
+  path = s ? s : "";
+  Py_DECREF(v);
+  return path.c_str();
+}
+
 /* ---- model + tensors ---- */
 
 flexflow_model_t flexflow_model_create(flexflow_config_t c) {

@@ -49,6 +49,10 @@ void flexflow_config_destroy(flexflow_config_t);
 int flexflow_config_get_batch_size(flexflow_config_t);
 int flexflow_config_get_epochs(flexflow_config_t);
 int flexflow_config_get_workers_per_node(flexflow_config_t);
+/* NetConfig (reference flexflow_c.h:520-528, :1055): dataset path parsed
+ * from the -d/--dataset flag.  Returns a pointer owned by the config —
+ * valid until flexflow_config_destroy. */
+const char* flexflow_config_get_dataset_path(flexflow_config_t);
 
 /* ---- model + tensors ---- */
 flexflow_model_t flexflow_model_create(flexflow_config_t);

@@ -141,14 +141,18 @@ class FFModel:
 
     def pipeline_transformer_block(self, input_tensor, num_stages, num_heads,
                                    d_ff, num_microbatches=None,
+                                   schedule="gpipe", virtual_stages=None,
                                    name=None) -> Tensor:
-        """A stack of identical encoder blocks run as a GPipe collective
-        pipeline over the 'p' mesh axis (beyond the reference — SURVEY
-        §2.15: FlexFlow has no stage pipeline)."""
+        """A stack of identical encoder blocks run as a collective pipeline
+        over the 'p' mesh axis (beyond the reference — SURVEY §2.15:
+        FlexFlow has no stage pipeline).  ``schedule``: "gpipe" or
+        "interleaved" (requires ``virtual_stages`` chunks per rank,
+        ~v-fold smaller bubble)."""
         from .ops.pipeline import PipelineTransformerBlock
         op = PipelineTransformerBlock(
             self._uname("pipeline_block", name), input_tensor, num_stages,
-            num_heads, d_ff, num_microbatches)
+            num_heads, d_ff, num_microbatches, schedule=schedule,
+            virtual_stages=virtual_stages)
         return self._register(op).outputs[0]
 
     def moe(self, input_tensor, num_experts, d_ff, k=2, capacity_factor=1.25,

@@ -26,6 +26,12 @@ from ..parallel.pipeline import pipeline_apply
 from .common import cast_compute
 
 
+def _single_mesh():
+    """A 1-device mesh handle for the sequential fallback path."""
+    from ..parallel.mesh import MachineMesh
+    return MachineMesh({"n": 1})
+
+
 class _StackedInit:
     """Stacks a base initializer over per-stage keys, so stage i of the
     pipeline initializes exactly like an unstacked block with key_i."""
@@ -43,7 +49,8 @@ class PipelineTransformerBlock(Op):
 
     def __init__(self, name, input_tensor, num_stages, num_heads,
                  d_ff, num_microbatches=None, eps=1e-5,
-                 kernel_initializer=None):
+                 kernel_initializer=None, schedule="gpipe",
+                 virtual_stages=None):
         super().__init__(name, [input_tensor])
         n, s, d = input_tensor.shape
         assert d % num_heads == 0, (d, num_heads)
@@ -52,6 +59,11 @@ class PipelineTransformerBlock(Op):
         self.head_dim = d // num_heads
         self.d_ff, self.eps = d_ff, eps
         self.num_microbatches = num_microbatches
+        # "gpipe" or "interleaved" (virtual_stages chunks per rank, ~v-fold
+        # smaller bubble; traversal order pinned mesh-independently — see
+        # parallel/pipeline.py traversal_order)
+        self.schedule = schedule
+        self.virtual_stages = virtual_stages
         self._add_output((n, s, d), input_tensor.dtype)
         S = self.num_stages
         base = kernel_initializer or GlorotUniform()
@@ -135,14 +147,11 @@ class PipelineTransformerBlock(Op):
                  "ln2_scale": self.w_ln2s, "ln2_bias": self.w_ln2b}
         stacked = {k: params[p.name] for k, p in names.items()}
         block = self._stage_fn(ctx)
-        if ctx.mesh is not None and ctx.mesh.axis_size("p") > 1:
-            y = pipeline_apply(block, stacked, x, ctx.mesh,
-                               self.num_microbatches)
-        else:
-            def body(hh, p):
-                return block(p, hh), None
-
-            y, _ = jax.lax.scan(body, x, stacked)
+        y = pipeline_apply(block, stacked, x,
+                           ctx.mesh if ctx.mesh is not None
+                           else _single_mesh(), self.num_microbatches,
+                           schedule=self.schedule,
+                           virtual_stages=self.virtual_stages)
         return [cast_compute(y, ctx)]
 
     def parallel_dims(self):

@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Per-op isolated timing of inception_v3 on the attached chip, DEDUPED by
+(op type, shapes) so each unique configuration compiles once (a naive
+all-ops sweep is ~190 compiles x2 and exceeds any sane timeout).  Prints
+incrementally (run with stdout to a file) and ends with a summary of the
+worst offenders vs the fused-step time — the trace-driven analysis VERDICT
+round-2 ask #1 requires.
+
+Usage (chip must be free):  python scripts/profile_inception.py > prof.log
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.models.inception import build_inception_v3
+from flexflow_tpu.profiling import profile_op
+
+
+def op_key(op):
+    return (op.op_type.value,
+            tuple(t.shape for t in op.inputs),
+            tuple(t.shape for t in op.outputs),
+            tuple(w.shape for w in op.weights),
+            getattr(op, "stride", None), getattr(op, "kernel", None),
+            getattr(op, "groups", None))
+
+
+def main():
+    batch = 128
+    cfg = ff.FFConfig(batch_size=batch, compute_dtype="bfloat16")
+    model, inp, logits = build_inception_v3(cfg, num_classes=1000,
+                                            image_size=299)
+    model.compile(ff.SGDOptimizer(lr=0.01),
+                  ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY, [],
+                  final_tensor=logits)
+    groups = {}
+    for op in model.layers:
+        groups.setdefault(op_key(op), []).append(op)
+    print(f"{len(model.layers)} ops -> {len(groups)} unique shapes",
+          flush=True)
+    rows = []
+    for i, (key, ops) in enumerate(groups.items()):
+        op = ops[0]
+        r = profile_op(op, "bfloat16", warmup=1, iters=4)
+        tot = (r["fwd_ms"] + r["bwd_ms"]) * len(ops)
+        fl = op.flops() * len(ops)
+        mfu = (3 * fl / 1e12) / (tot / 1e3) / 197.0 if tot > 0 else 0.0
+        rows.append((op.name, len(ops), r["fwd_ms"], r["bwd_ms"], tot, fl,
+                     mfu))
+        print(f"[{i+1}/{len(groups)}] {op.name:28s} x{len(ops):3d} "
+              f"fwd={r['fwd_ms']:7.3f} bwd={r['bwd_ms']:7.3f} "
+              f"group_total={tot:8.2f}ms gflop={fl/1e9:8.1f} "
+              f"mfu={mfu:6.2%}", flush=True)
+    tot_all = sum(r[4] for r in rows)
+    fl_all = sum(r[5] for r in rows)
+    print(f"\nTOTAL isolated fwd+bwd: {tot_all:.1f}ms; model fwd "
+          f"GFLOP={fl_all/1e9:.1f}", flush=True)
+    print("\nworst 12 groups by total time:")
+    for name, cnt, fwd, bwd, tot, fl, mfu in sorted(rows,
+                                                    key=lambda r: -r[4])[:12]:
+        print(f"  {name:28s} x{cnt:3d} {tot:8.2f}ms  "
+              f"{fl/1e9:8.1f}GF  {mfu:6.2%}")
+
+
+if __name__ == "__main__":
+    main()
