@@ -9,6 +9,9 @@ import flexflow_tpu as ff
 
 def top_level_task():
     cfg = ff.get_default_config()
+    # this example verifies byte-exact staging, not MXU math: compute in f32
+    # so the forward check can use a tight tolerance
+    cfg.compute_dtype = "float32"
     model = ff.FFModel(cfg)
     x = model.create_tensor((cfg.batch_size, 64), name="x")
     model.dense(x, 32, name="fc")

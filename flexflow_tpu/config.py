@@ -129,11 +129,12 @@ class FFConfig:
     mesh_shape: Optional[Dict[str, int]] = None  # explicit mesh override
     simulator_mode: str = "analytic"  # "analytic" | "measure"
     remat: bool = False  # jax.checkpoint the forward pass
-    # opt-in Pallas flash-attention kernel: wins at long sequence lengths
-    # where the O(s^2) score matrix stops fitting fused on-chip, but loses
-    # to XLA's fused dense attention at moderate s (measured: 2x slower at
-    # s=512 on v5e) — benchmark per workload before enabling
-    flash_attention: bool = False
+    # Pallas flash-attention kernel.  None = auto: flash at s >= 1024
+    # (measured on v5e: flash 2.7-2.8x faster at s=1024..3072, only
+    # source of attention at s >= 8192 where the dense f32 score matrix
+    # exceeds HBM; XLA's fused dense attention wins below s=1024 — see
+    # BASELINE.md "Flash attention").  True/False force the choice.
+    flash_attention: Optional[bool] = None
     # when set, fit() wraps the epoch loop in a jax.profiler trace whose
     # dump lands here (TensorBoard-loadable) — the XLA-level complement of
     # --profiling's per-op table

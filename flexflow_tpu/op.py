@@ -63,7 +63,9 @@ class OpContext:
     rng: Optional[jax.Array] = None
     compute_dtype: str = "bfloat16"
     mesh: Optional[object] = None  # MachineMesh when compiled multi-chip
-    flash_attention: bool = False  # opt-in Pallas kernel (FFConfig)
+    # Pallas flash attention: None = auto (flash at s >= 1024 on TPU,
+    # the measured v5e crossover — see FFConfig.flash_attention)
+    flash_attention: Optional[bool] = None
     # functional state updates: ops write {param_name: new_value} here for
     # non-trainable state (batchnorm running stats); the train step returns
     # them as part of the new params pytree
@@ -118,6 +120,21 @@ class Op:
     def flops(self) -> int:
         """Forward FLOPs for the whole (unpartitioned) op."""
         return 2 * self.outputs[0].volume if self.outputs else 0
+
+    def mxu_efficiency(self) -> float:
+        """Fraction of MXU peak this op's contraction can reach (default
+        1.0).  Convs with tiny input-channel counts can't fill the
+        systolic array's reduction dimension — the ImageNet stem conv
+        measures ~2x its ideal roofline time (calibration)."""
+        return 1.0
+
+    def internal_io_bytes(self) -> int:
+        """HBM traffic of intermediates that never appear as op inputs or
+        outputs (default none).  The roofline only sees boundary tensors;
+        ops that materialize large internals (dense attention's f32 score
+        matrix, batchnorm's f32 stats passes) override this — calibrated
+        against on-chip measurements (scripts/calibrate_cost_model.py)."""
+        return 0
 
     def weight_bytes(self) -> int:
         return sum(w.volume * 4 for w in self.weights)

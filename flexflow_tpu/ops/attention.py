@@ -34,18 +34,40 @@ from .common import cast_compute
 NEG_INF = -1e30  # finite mask value: keeps online-softmax exp() NaN-free
 
 
-def _flash_attention_ok(q, k, training_dropout: bool) -> bool:
-    """The Pallas TPU flash kernel applies when running on TPU with
-    kernel-friendly shapes and no attention-prob dropout (the kernel never
+def _use_flash(q, k, ctx_flag, training_dropout: bool) -> bool:
+    """Kernel selection.  ``ctx_flag`` None = auto: flash at s >= 1024,
+    the measured v5e crossover (BASELINE.md "Flash attention": flash is
+    2.7-2.8x faster at s=1024..3072 and the only option at s >= 8192
+    where the dense f32 score matrix exceeds HBM; XLA's fused dense
+    attention wins below).  The kernel requires TPU, 128-aligned seq
+    lens, lane-block head_dim, and no attention-prob dropout (it never
     materializes probabilities)."""
     if training_dropout or jax.default_backend() != "tpu":
         return False
     sq, sk, d = q.shape[1], k.shape[1], q.shape[3]
-    # the kernel truncates head_dim < 128 to a lane block; >= 128 must be a
-    # multiple of its 128 MIN_BLOCK_SIZE
-    return (sq % 128 == 0 and sk % 128 == 0
-            and (d < 128 or d % 128 == 0)
-            and q.dtype in (jnp.float32, jnp.bfloat16))
+    ok = (sq % 128 == 0 and sk % 128 == 0
+          and (d < 128 or d % 128 == 0)
+          and q.dtype in (jnp.float32, jnp.bfloat16))
+    if ctx_flag is None:
+        return ok and max(sq, sk) >= 1024
+    return ctx_flag and ok
+
+
+def _tuned_block_sizes(sq: int, sk: int):
+    """v5e-tuned kernel blocks (scripts/tune_flash_attention.py): q 512 /
+    kv 1024 is within 4% of best at every measured s >= 1024.  Falls back
+    to kernel defaults when the tuned blocks don't divide the seq lens."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
+
+    bq = 512 if sq % 512 == 0 else None
+    bkv = next((b for b in (1024, 512) if sk % b == 0), None)
+    if bq is None or bkv is None:
+        return None
+    return BlockSizes(
+        block_q=bq, block_k_major=bkv, block_k=bkv, block_b=1,
+        block_q_major_dkv=bq, block_k_major_dkv=bkv,
+        block_k_dkv=bkv, block_q_dkv=bq,
+        block_k_major_dq=bkv, block_k_dq=bkv, block_q_dq=bq)
 
 
 def _flash_attention(q, k, v, causal: bool, scale: float):
@@ -59,7 +81,8 @@ def _flash_attention(q, k, v, causal: bool, scale: float):
     qt = jnp.transpose(q, (0, 2, 1, 3))
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
-    out = _fa(qt, kt, vt, causal=causal, sm_scale=scale)
+    out = _fa(qt, kt, vt, causal=causal, sm_scale=scale,
+              block_sizes=_tuned_block_sizes(q.shape[1], k.shape[1]))
     return jnp.transpose(out, (0, 2, 1, 3))
 
 
@@ -238,8 +261,7 @@ class MultiHeadAttention(Op):
         if self._wants_ring(ctx):
             attn = ring_attention(q, k, v, ctx.mesh, self.causal, scale,
                                   self.dropout if ctx.training else 0.0, rng)
-        elif ctx.flash_attention and _flash_attention_ok(q, k,
-                                                         rng is not None):
+        elif _use_flash(q, k, ctx.flash_attention, rng is not None):
             attn = _flash_attention(q, k, v, self.causal, scale)
         else:
             attn = _dense_attention(q, k, v, self.causal, scale,
@@ -264,6 +286,17 @@ class MultiHeadAttention(Op):
             self.inputs[1].shape[1]
         scores = 2 * 2 * n * s * sk * d       # qk^T and probs*v
         return proj + scores
+
+    def internal_io_bytes(self):
+        n, sq, _ = self.outputs[0].shape
+        sk = self.inputs[0].shape[1] if self._self_attn else \
+            self.inputs[1].shape[1]
+        if max(sq, sk) >= 1024 and sq % 128 == 0 and sk % 128 == 0:
+            return 0  # flash kernel auto-selected: scores stay in VMEM
+        # dense path: f32 scores written + read (softmax) + bf16 probs
+        # written + read = 12 B/element (calibrated: attn768 measured
+        # 1.63ms fwd vs 0.53ms analytic without this term)
+        return 12 * n * self.num_heads * sq * sk
 
 
 class PositionEmbedding(Op):

@@ -76,6 +76,12 @@ class Conv2D(Op):
         # n/h/w splittable, c not (reference conv_2d.cu:201)
         return (True, False, True, True)
 
+    def mxu_efficiency(self):
+        # the MXU reduces over in_channels x kernel window; C_in < 8
+        # can't fill the reduction lanes (stem conv: measured 0.63ms vs
+        # 0.30ms ideal at C_in=3, scripts/calibrate_cost_model.py)
+        return min(1.0, self.in_channels / 8.0)
+
     def flops(self):
         n, c_out, oh, ow = self.outputs[0].shape
         kh, kw = self.kernel

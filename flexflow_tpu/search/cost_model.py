@@ -84,10 +84,14 @@ def op_compute_time(op: Op, part_degrees: Tuple[int, ...],
     if backward:
         flops *= 2.0
     peak = spec.vpu_flops if op.op_type in _VPU_OPS else spec.mxu_flops
+    peak *= op.mxu_efficiency()
     io_bytes = 0
     for t in list(op.inputs) + list(op.outputs):
         io_bytes += t.volume * dtype_bytes
     io_bytes += sum(w.volume * 4 for w in op.weights)
+    # intermediates the boundary tensors don't show (dense attention's
+    # f32 score matrix, norm-stat passes) — see Op.internal_io_bytes
+    io_bytes += op.internal_io_bytes()
     io_bytes /= max(1, nparts)
     if backward:
         io_bytes *= 2.0

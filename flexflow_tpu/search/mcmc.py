@@ -151,7 +151,7 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
            alpha: float = 0.05, seed: int = 0,
            spec: Optional[DeviceSpec] = None, measure: bool = False,
            overlap_backward_update: bool = False,
-           verbose: bool = False, flash_attention: bool = False
+           verbose: bool = False, flash_attention=None
            ) -> Tuple[Dict[str, ParallelConfig], MeshShape, float]:
     """Run the annealing loop; returns (best strategies, best mesh
     factorization, best simulated time)."""

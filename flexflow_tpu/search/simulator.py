@@ -76,7 +76,7 @@ class Simulator:
     def __init__(self, spec: Optional[DeviceSpec] = None,
                  num_devices: int = 1, devices_per_slice: int = 0,
                  measure: bool = False, dtype_bytes: int = 2,
-                 use_native: bool = True, flash_attention: bool = False):
+                 use_native: bool = True, flash_attention=None):
         self.spec = spec if spec is not None else spec_for_device()
         self.num_devices = num_devices
         self.devices_per_slice = devices_per_slice or num_devices

@@ -18,15 +18,38 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def fence(out):
+    # host-fetch one element: on tunneled PJRT backends block_until_ready
+    # returns at dispatch, not completion (see flexflow_tpu/profiling.py)
+    np.asarray(out[(0,) * out.ndim])
+
+
 def bench(fn, *args, iters=10):
+    """Two-point slope timing: the fence round-trip is ~70ms on the debug
+    tunnel, so time N and 3N dispatches and take the slope — the constant
+    (dispatch + fence) term cancels exactly.  Tunnel jitter swamps sub-ms
+    kernels, so scale N to a ~200ms window and take the median of 3."""
     fn_j = jax.jit(fn)
-    out = fn_j(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn_j(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters * 1e3
+    fence(fn_j(*args))
+    fence(fn_j(*args))
+
+    def run(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn_j(*args)
+        fence(out)
+        return time.perf_counter() - t0
+
+    def slope(n):
+        t1 = run(n)
+        t3 = run(3 * n)
+        return max(0.0, (t3 - t1) / (2 * n))
+
+    est = slope(iters)
+    n = iters
+    if est * n < 0.2:
+        n = min(1000, int(0.2 / max(est, 2e-4)) + 1)
+    return sorted(slope(n) for _ in range(3))[1] * 1e3
 
 
 def main():
