@@ -128,12 +128,16 @@ class Op:
         measures ~2x its ideal roofline time (calibration)."""
         return 1.0
 
-    def internal_io_bytes(self) -> int:
+    def internal_io_bytes(self, flash_attention=None) -> int:
         """HBM traffic of intermediates that never appear as op inputs or
         outputs (default none).  The roofline only sees boundary tensors;
         ops that materialize large internals (dense attention's f32 score
         matrix, batchnorm's f32 stats passes) override this — calibrated
-        against on-chip measurements (scripts/calibrate_cost_model.py)."""
+        against on-chip measurements (scripts/calibrate_cost_model.py).
+        ``flash_attention`` is the run's configured kernel-selection flag
+        (FFConfig.flash_attention), forwarded by the cost model so ops
+        whose internal traffic depends on which kernel actually runs
+        (MultiHeadAttention) can model the right one."""
         return 0
 
     def weight_bytes(self) -> int:

@@ -287,12 +287,26 @@ class MultiHeadAttention(Op):
         scores = 2 * 2 * n * s * sk * d       # qk^T and probs*v
         return proj + scores
 
-    def internal_io_bytes(self):
+    def internal_io_bytes(self, flash_attention=None):
+        """Mirrors ``_use_flash``'s full selection (the cost model must
+        charge for the kernel that will actually run): the flash kernel
+        needs no attention-prob dropout, 128-aligned seq lens, and a
+        lane-block head_dim; ``flash_attention`` False forces dense, True
+        forces flash where legal, None = auto (s >= 1024).  The backend
+        check in ``_use_flash`` is deliberately absent — the search costs
+        a TPU run even when it executes on the CPU mesh."""
         n, sq, _ = self.outputs[0].shape
         sk = self.inputs[0].shape[1] if self._self_attn else \
             self.inputs[1].shape[1]
-        if max(sq, sk) >= 1024 and sq % 128 == 0 and sk % 128 == 0:
-            return 0  # flash kernel auto-selected: scores stay in VMEM
+        flash_legal = (self.dropout == 0.0
+                       and sq % 128 == 0 and sk % 128 == 0
+                       and (self.head_dim < 128 or self.head_dim % 128 == 0))
+        if flash_attention is None:
+            flash = flash_legal and max(sq, sk) >= 1024
+        else:
+            flash = flash_attention and flash_legal
+        if flash:
+            return 0  # flash kernel: scores stay in VMEM
         # dense path: f32 scores written + read (softmax) + bf16 probs
         # written + read = 12 B/element (calibrated: attn768 measured
         # 1.63ms fwd vs 0.53ms analytic without this term)

@@ -64,7 +64,7 @@ class BatchNorm(Op):
     def flops(self):
         return 8 * self.outputs[0].volume
 
-    def internal_io_bytes(self):
+    def internal_io_bytes(self, flash_attention=None):
         # f32 promotion + cross-sample stats pass + normalize re-read:
         # ~10 B/element beyond the boundary tensors (calibrated: bn35
         # measured 0.70ms fwd vs 0.20ms analytic without this term)
@@ -103,7 +103,7 @@ class LayerNorm(Op):
     def flops(self):
         return 8 * self.outputs[0].volume
 
-    def internal_io_bytes(self):
+    def internal_io_bytes(self, flash_attention=None):
         # f32 promotion + per-row stats pass (last-axis reduction is
         # cheaper than batchnorm's cross-sample pass)
         return 8 * self.inputs[0].volume
@@ -132,5 +132,5 @@ class RMSNorm(Op):
     def flops(self):
         return 4 * self.outputs[0].volume
 
-    def internal_io_bytes(self):
+    def internal_io_bytes(self, flash_attention=None):
         return 8 * self.inputs[0].volume

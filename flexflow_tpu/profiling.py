@@ -21,6 +21,12 @@ import numpy as np
 from .op import Op, OpContext
 
 
+class _NoFloatLeaf(ValueError):
+    """The op has no float leaf to chain the timing loop on — a distinct
+    type so profile_op's nan-degrade cannot mask genuine ValueErrors
+    raised while tracing/executing the op's forward."""
+
+
 def _example_inputs(op: Op):
     outs = []
     for t in op.inputs:
@@ -75,7 +81,13 @@ def profile_op(op: Op, compute_dtype: str = "bfloat16", warmup: int = 2,
         return jax.grad(loss, argnums=argnums)(
             params, *[inputs[i] for i in float_in])
 
-    fwd_ms = _time_loop(fwd, params, inputs, warmup, iters)
+    try:
+        fwd_ms = _time_loop(fwd, params, inputs, warmup, iters)
+    except _NoFloatLeaf:
+        # int-only inputs and no float weights (e.g. a reshape/split over
+        # token ids): no float leaf to chain the timing loop on — report
+        # nan instead of crashing the whole profile table (ADVICE r3 #2)
+        return {"fwd_ms": float("nan"), "bwd_ms": float("nan")}
     try:
         tot_ms = (_time_loop(fwd_bwd, params, inputs, warmup, iters)
                   if (params or float_in) else fwd_ms)
@@ -121,7 +133,7 @@ def _time_loop(fn_core, params, inputs, warmup: int, iters: int) -> float:
         cands = [("param", k, v) for k, v in params.items()
                  if jnp.issubdtype(v.dtype, jnp.floating)]
     if not cands:  # int-only op with no float weights: nothing to chain on
-        raise ValueError("no float leaf to chain the timing loop on")
+        raise _NoFloatLeaf("no float leaf to chain the timing loop on")
     kind, key, _ = min(cands, key=lambda c: c[2].size)
     target = (kind, key)
 

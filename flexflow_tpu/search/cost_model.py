@@ -72,7 +72,8 @@ _VPU_OPS = {
 
 def op_compute_time(op: Op, part_degrees: Tuple[int, ...],
                     spec: DeviceSpec = DEFAULT_SPEC,
-                    dtype_bytes: int = 2, backward: bool = False) -> float:
+                    dtype_bytes: int = 2, backward: bool = False,
+                    flash_attention=None) -> float:
     """Roofline time for ONE partition of ``op`` under the given degrees:
     max(compute, memory) + launch overhead.  Backward ~= 2x forward FLOPs
     (dgrad + wgrad), matching the reference's separate bwdData/bwdFilter
@@ -91,7 +92,7 @@ def op_compute_time(op: Op, part_degrees: Tuple[int, ...],
     io_bytes += sum(w.volume * 4 for w in op.weights)
     # intermediates the boundary tensors don't show (dense attention's
     # f32 score matrix, norm-stat passes) — see Op.internal_io_bytes
-    io_bytes += op.internal_io_bytes()
+    io_bytes += op.internal_io_bytes(flash_attention=flash_attention)
     io_bytes /= max(1, nparts)
     if backward:
         io_bytes *= 2.0

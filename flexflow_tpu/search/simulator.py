@@ -96,7 +96,8 @@ class Simulator:
             if key not in self._measure_cache:
                 self._measure_cache[key] = self._measure_op(op, dims, backward)
             return self._measure_cache[key]
-        return op_compute_time(op, dims, self.spec, self.dtype_bytes, backward)
+        return op_compute_time(op, dims, self.spec, self.dtype_bytes, backward,
+                               flash_attention=self.flash_attention)
 
     def _measure_op(self, op: Op, dims: Tuple[int, ...], backward: bool) -> float:
         """On-hardware microbenchmark of one op sub-shape (reference

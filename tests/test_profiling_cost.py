@@ -1,0 +1,79 @@
+"""Cost-model / profiler regression tests (ADVICE r3 findings).
+
+The profiler must not crash on ops with no float leaf to chain timing on,
+and the attention op's internal-IO model must charge for the kernel that
+will actually run under the configured ``flash_attention`` flag — the
+dense path's 12 B/element score-matrix traffic is the dominant roofline
+term for the MCMC search (reference analogue: measured per-config costs,
+src/runtime/simulator.cc:235-273).
+"""
+
+import math
+
+import numpy as np
+
+from flexflow_tpu.ops.attention import MultiHeadAttention
+from flexflow_tpu.ops.tensor_ops import Reshape
+from flexflow_tpu.profiling import profile_op
+from flexflow_tpu.tensor import Tensor
+
+
+def test_profile_op_int_only_returns_nan():
+    # a reshape over token ids: int-only input, no weights — the timing
+    # loop has no float leaf to chain on and must degrade to nan, not raise
+    t = Tensor((4, 8), dtype="int32", name="ids")
+    op = Reshape("rs", t, (8, 4))
+    r = profile_op(op, iters=2, warmup=1)
+    assert math.isnan(r["fwd_ms"]) and math.isnan(r["bwd_ms"])
+
+
+def _attn(seq=1024, embed=768, heads=12, dropout=0.0):
+    q = Tensor((2, seq, embed), name="q")
+    return MultiHeadAttention("attn", q, q, q, embed, heads, dropout=dropout)
+
+
+def _dense_bytes(op):
+    n, sq, _ = op.outputs[0].shape
+    return 12 * n * op.num_heads * sq * sq
+
+
+def test_attention_io_auto_selects_flash_at_1024():
+    op = _attn(seq=1024)
+    assert op.internal_io_bytes(flash_attention=None) == 0
+    assert op.internal_io_bytes(flash_attention=True) == 0
+    # forcing dense must restore the score-matrix traffic
+    assert op.internal_io_bytes(flash_attention=False) == _dense_bytes(op)
+
+
+def test_attention_io_dense_below_crossover_unless_forced():
+    op = _attn(seq=512)
+    assert op.internal_io_bytes(flash_attention=None) == _dense_bytes(op)
+    assert op.internal_io_bytes(flash_attention=True) == 0  # legal, forced
+
+
+def test_attention_io_dropout_disables_flash():
+    # the flash kernel never materializes probabilities, so attention-prob
+    # dropout forces the dense path at runtime — the model must follow
+    op = _attn(seq=1024, dropout=0.1)
+    assert op.internal_io_bytes(flash_attention=None) == _dense_bytes(op)
+    assert op.internal_io_bytes(flash_attention=True) == _dense_bytes(op)
+
+
+def test_attention_io_head_dim_alignment():
+    # head_dim 160: neither <128 nor a lane-block multiple — flash illegal
+    op = _attn(seq=1024, embed=320, heads=2)
+    assert op.internal_io_bytes(flash_attention=True) == _dense_bytes(op)
+
+
+def test_attention_io_misaligned_seq():
+    op = _attn(seq=1088 + 8)  # not 128-aligned
+    assert op.internal_io_bytes(flash_attention=True) == _dense_bytes(op)
+
+
+def test_cost_model_forwards_flash_flag():
+    from flexflow_tpu.search.cost_model import DEFAULT_SPEC, op_compute_time
+    op = _attn(seq=2048)
+    t_flash = op_compute_time(op, (1,), DEFAULT_SPEC, flash_attention=True)
+    t_dense = op_compute_time(op, (1,), DEFAULT_SPEC, flash_attention=False)
+    assert t_dense > t_flash  # dense pays the score-matrix HBM term
+    assert np.isfinite(t_dense) and np.isfinite(t_flash)
