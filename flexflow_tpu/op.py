@@ -55,6 +55,21 @@ class OpType(enum.Enum):
     INPUT = "input"
 
 
+def pad_degrees(part_degrees, rank: int):
+    """Output partition degrees padded/truncated to ``rank`` dims — the
+    one shared idiom for aligning a strategy's degree tuple to a tensor's
+    rank (graph simulator, memory model, and measure mode must agree)."""
+    return tuple(part_degrees[:rank]) + \
+        (1,) * max(0, rank - len(part_degrees))
+
+
+def snap_degrees(dims, shape):
+    """Replicate (degree 1) any dim a degree does not divide — the graph
+    simulator's fallback for indivisible inputs (simulator.simulate_py)."""
+    return tuple(d if d <= s and s % max(1, d) == 0 else 1
+                 for d, s in zip(dims, shape))
+
+
 @dataclasses.dataclass
 class OpContext:
     """Per-trace execution context threaded through op forward functions."""
@@ -142,6 +157,26 @@ class Op:
 
     def weight_bytes(self) -> int:
         return sum(w.volume * 4 for w in self.weights)
+
+    def sub_problem(self, part_degrees):
+        """Per-partition (input_shapes, weight_shapes) for timing ONE shard
+        of this op in isolation (measure mode — the reference's sub-rect
+        construction in Op::measure_compute_time, simulator.cc:235-273).
+
+        Default: project the output partition degrees dimension-wise onto
+        each input, replicating (degree 1) any input dim the degree does
+        not divide — the same fallback the graph simulator applies, so
+        measure mode never bans a config the analytic path allows.
+        Weights stay full-size.  Ops with reduction/TP semantics (Linear,
+        Conv2D, Embedding) override — a channel split shards the WEIGHT,
+        not the input's feature dim.  Raises ValueError for degrees that
+        are genuinely unrealizable (the simulator scores those inf)."""
+        in_shapes = []
+        for t in self.inputs:
+            dims = snap_degrees(pad_degrees(part_degrees, t.num_dims),
+                                t.shape)
+            in_shapes.append(t.sub_shape(dims))
+        return in_shapes, {w.name: w.shape for w in self.weights}
 
     def activation_bytes(self) -> int:
         return sum(t.volume * 4 for t in self.outputs)

@@ -279,6 +279,20 @@ class MultiHeadAttention(Op):
         # (n, s, c): sample DP, sequence SP (ring), channel TP (heads)
         return (True, True, True)
 
+    def sub_problem(self, part_degrees):
+        # batch/sequence degrees shard the inputs; the head-TP (c) degree
+        # is timed CONSERVATIVELY at full width (forward's reshape is tied
+        # to num_heads, so a sharded sub-op can't run in isolation) — the
+        # measured per-part cost upper-bounds the true c-split cost
+        from ..op import pad_degrees, snap_degrees
+        dims = pad_degrees(part_degrees, 3)
+        dn, ds = dims[0], dims[1]
+        in_shapes = []
+        for t in self.inputs:
+            d = snap_degrees((dn, ds) + (1,) * (t.num_dims - 2), t.shape)
+            in_shapes.append(t.sub_shape(d))
+        return in_shapes, {w.name: w.shape for w in self.weights}
+
     def flops(self):
         n, s, d = self.outputs[0].shape
         proj = 4 * 2 * n * s * d * d          # q,k,v,o projections

@@ -87,6 +87,29 @@ class Conv2D(Op):
         kh, kw = self.kernel
         return 2 * n * c_out * oh * ow * (self.in_channels // self.groups) * kh * kw
 
+    def sub_problem(self, part_degrees):
+        # the c split shards OIHW filter count (input channels stay full —
+        # output-channel parallelism replicates the input, conv_2d.cu); the
+        # n/h/w splits shard the input box (halo ignored: one kernel row of
+        # overlap is noise next to the tile itself)
+        from ..op import pad_degrees
+        n, cin, h, w = self.inputs[0].shape
+        out = self.outputs[0]
+        dn, dc, dh, dw = pad_degrees(part_degrees, 4)
+        dims = (dn, dc, dh, dw)
+        if n % max(1, dn) or self.out_channels % max(1, dc):
+            raise ValueError(f"conv degrees {dims} don't divide")
+        if out.shape[2] % max(1, dh) or out.shape[3] % max(1, dw):
+            raise ValueError(f"conv spatial degrees {dims} don't divide")
+        in_shape = (n // max(1, dn), cin, max(1, h // max(1, dh)),
+                    max(1, w // max(1, dw)))
+        kh, kw = self.kernel
+        shapes = {self.w_kernel.name: (self.out_channels // max(1, dc),
+                                       cin // self.groups, kh, kw)}
+        if self.use_bias:
+            shapes[self.w_bias.name] = (self.out_channels // max(1, dc),)
+        return [in_shape], shapes
+
 
 class Pool2D(Op):
     """Max/avg pooling (reference pool_2d.cu, cuDNN pooling)."""

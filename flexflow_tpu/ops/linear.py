@@ -93,6 +93,23 @@ class Linear(Op):
         batch = self.outputs[0].volume // self.out_dim
         return 2 * batch * self.in_dim * self.out_dim
 
+    def sub_problem(self, part_degrees):
+        # a c split on the output shards the (out, in) kernel's rows; the
+        # input is replicated at full feature width (linear.cu:168-207)
+        from ..op import pad_degrees
+        out = self.outputs[0]
+        dims = pad_degrees(part_degrees, out.num_dims)
+        c_deg = dims[-1]
+        if self.out_dim % max(1, c_deg):
+            raise ValueError(f"out_dim {self.out_dim} % c {c_deg}")
+        x = self.inputs[0]
+        in_shape = x.sub_shape(dims[:-1] + (1,))
+        shapes = {self.w_kernel.name: (self.out_dim // max(1, c_deg),
+                                       self.in_dim)}
+        if self.use_bias:
+            shapes[self.w_bias.name] = (self.out_dim // max(1, c_deg),)
+        return [in_shape], shapes
+
 
 class Embedding(Op):
     op_type = OpType.EMBEDDING
@@ -137,3 +154,21 @@ class Embedding(Op):
 
     def flops(self):
         return self.outputs[0].volume
+
+    def sub_problem(self, part_degrees):
+        # the out-dim split shards the table's columns; the id input only
+        # splits over batch/sequence degrees (embedding.cu:95-103)
+        from ..op import pad_degrees
+        out = self.outputs[0]
+        dims = pad_degrees(part_degrees, out.num_dims)
+        c_deg = dims[-1]
+        if self.out_dim % max(1, c_deg):
+            raise ValueError(f"out_dim {self.out_dim} % c {c_deg}")
+        ids = self.inputs[0]
+        if self.aggr == "none":  # (n, s) ids mirror the (n, s, d) output
+            id_dims = dims[: ids.num_dims]
+        else:  # (n, bag) ids: only the sample degree applies
+            id_dims = (dims[0],) + (1,) * (ids.num_dims - 1)
+        in_shape = ids.sub_shape(id_dims)
+        return [in_shape], {self.w_table.name: (
+            self.num_entries, self.out_dim // max(1, c_deg))}

@@ -108,3 +108,16 @@ class LSTM(Op):
         n, s, h = self.outputs[0].shape
         d = self.inputs[0].shape[-1]
         return 2 * n * s * 4 * h * (d + h)
+
+    def sub_problem(self, part_degrees):
+        # batch degree shards every input's leading dim; the hidden-TP (c)
+        # degree is timed CONSERVATIVELY at full width (forward's 4-way
+        # gate split is tied to hidden_size, so a sharded sub-op can't run
+        # in isolation) — same upper-bound treatment as attention
+        from ..op import pad_degrees
+        dn = pad_degrees(part_degrees, 3)[0]
+        in_shapes = []
+        for t in self.inputs:
+            in_shapes.append(t.sub_shape((dn,) + (1,) * (t.num_dims - 1))
+                             if t.shape[0] % max(1, dn) == 0 else t.shape)
+        return in_shapes, {w.name: w.shape for w in self.weights}

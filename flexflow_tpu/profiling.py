@@ -27,39 +27,49 @@ class _NoFloatLeaf(ValueError):
     raised while tracing/executing the op's forward."""
 
 
-def _example_inputs(op: Op):
+def _example_inputs(op: Op, shapes=None, seed: int = 0):
+    """Random float inputs (zeros/ones can flatter ops with data-dependent
+    timing — ADVICE r3 on measure mode); int inputs stay zeros (always a
+    valid index).  ``shapes`` overrides the declared shapes (measure mode's
+    per-partition sub-shapes)."""
+    rng = np.random.default_rng(seed)
     outs = []
-    for t in op.inputs:
+    for i, t in enumerate(op.inputs):
+        shape = tuple(shapes[i]) if shapes is not None else t.shape
         if t.dtype.startswith("int"):
-            outs.append(jnp.zeros(t.shape, jnp.dtype(t.dtype)))
+            outs.append(jnp.zeros(shape, jnp.dtype(t.dtype)))
         else:
-            outs.append(jnp.ones(t.shape, jnp.dtype(t.dtype)))
+            outs.append(jnp.asarray(rng.standard_normal(shape),
+                                    jnp.dtype(t.dtype)))
     return outs
 
 
-def _init_params(op: Op, seed: int = 0) -> Dict[str, jax.Array]:
+def _init_params(op: Op, seed: int = 0, shapes=None) -> Dict[str, jax.Array]:
     from .initializers import GlorotUniform
     key = jax.random.PRNGKey(seed)
     params = {}
     for i, p in enumerate(op.weights):
         init = p.initializer or GlorotUniform()
-        params[p.name] = init(jax.random.fold_in(key, i), p.shape,
+        shape = tuple(shapes.get(p.name, p.shape)) if shapes else p.shape
+        params[p.name] = init(jax.random.fold_in(key, i), shape,
                               jnp.dtype(p.dtype))
     return params
 
 
 def profile_op(op: Op, compute_dtype: str = "bfloat16", warmup: int = 2,
-               iters: int = 5, flash_attention=None
-               ) -> Dict[str, float]:
+               iters: int = 5, flash_attention=None, input_shapes=None,
+               weight_shapes=None) -> Dict[str, float]:
     """(fwd_ms, bwd_ms) for one op, timed in isolation (reference
     measure_compute_time contract: returns per-config latency).  The ctx
     mirrors the run's kernel choices (flash_attention) so the numbers match
-    what fit() actually executes."""
+    what fit() actually executes.  ``input_shapes``/``weight_shapes``
+    override the declared shapes — the simulator's measure mode times one
+    PARTITION of the op this way (Op.sub_problem)."""
     ctx = OpContext(training=True, rng=jax.random.PRNGKey(0),
                     compute_dtype=compute_dtype,
                     flash_attention=flash_attention)
-    params = _init_params(op)
-    inputs = _example_inputs(op)
+    params = _init_params(op, shapes=weight_shapes)
+    inputs = _example_inputs(op, shapes=input_shapes)
 
     def fwd(params, inputs):
         return op.forward(params, inputs, ctx)[0]

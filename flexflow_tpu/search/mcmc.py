@@ -151,13 +151,20 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
            alpha: float = 0.05, seed: int = 0,
            spec: Optional[DeviceSpec] = None, measure: bool = False,
            overlap_backward_update: bool = False,
-           verbose: bool = False, flash_attention=None
+           verbose: bool = False, flash_attention=None,
+           devices_per_slice: int = 0, remat: bool = False,
+           compute_dtype: str = "bfloat16"
            ) -> Tuple[Dict[str, ParallelConfig], MeshShape, float]:
     """Run the annealing loop; returns (best strategies, best mesh
-    factorization, best simulated time)."""
+    factorization, best simulated time).  ``devices_per_slice`` < the
+    device count makes the objective slice-aware: weight-sync replica
+    groups that cross a slice pay the DCN term (reference
+    simulator.cu:27-29 inter-node fabric)."""
     rng = random.Random(seed)
     sim = Simulator(spec=spec, num_devices=num_devices, measure=measure,
-                    flash_attention=flash_attention)
+                    flash_attention=flash_attention,
+                    devices_per_slice=devices_per_slice, remat=remat,
+                    compute_dtype=compute_dtype)
     meshes = candidate_meshes(num_devices)
 
     def dp_mesh() -> MeshShape:
@@ -232,12 +239,18 @@ def optimize_strategies(model, cfg: FFConfig) -> Dict[str, ParallelConfig]:
     import jax
 
     ndev = cfg.num_devices if cfg.workers_per_node else len(jax.devices())
+    # --nodes N: each node/slice shares one ICI domain; weight sync
+    # crossing it is costed over DCN (the reference's 12/numNodes GB/s
+    # inter-node term, simulator.cu:27-29, was dead code here until r4)
+    dps = ndev // max(1, cfg.num_nodes)
     best, best_mesh, best_time = search(
         model.layers, ndev, budget=cfg.search_budget,
         alpha=cfg.search_alpha, seed=cfg.seed,
         measure=(cfg.simulator_mode == "measure"),
         overlap_backward_update=cfg.search_overlap_backward_update,
-        flash_attention=cfg.flash_attention)
+        flash_attention=cfg.flash_attention,
+        devices_per_slice=dps, remat=cfg.remat,
+        compute_dtype=cfg.compute_dtype)
     print(f"[search] best simulated iteration time: {best_time * 1e3:.3f} ms "
           f"on {ndev} devices, mesh "
           f"{ {a: s for a, s in best_mesh.items() if s > 1} }")
