@@ -11,8 +11,11 @@ the device count over the canonical axes (n/c/h/w/s) as part of its state,
 and per-op degrees are drawn from the divisors of the chosen axis sizes —
 exactly the space MachineMesh's prime sub-axes can realize (mesh.py), so
 every strategy this module returns compiles and runs.  A proposal either
-mutates one op (the reference's ``rewrite``) or re-factorizes the mesh and
-snaps all op configs into the new axis sizes.
+mutates one op (the reference's ``rewrite``) or re-factorizes the mesh,
+re-seeding every op from a greedy per-op-cost or fully-aligned init for
+the new axis sizes; the anneal also STARTS from the best such seed across
+all factorizations (multi-start), because the mesh-constrained space
+leaves hybrid optima unreachable from a pure-DP start.
 """
 
 from __future__ import annotations
@@ -69,19 +72,14 @@ def _prod(xs) -> int:
     return n
 
 
-def legal_configs(op: Op, mesh_shape: MeshShape,
-                  max_candidates: int = 1024,
-                  seed: int = 0) -> List[ParallelConfig]:
-    """Legal configs for one op under a fixed mesh factorization: each
-    output dim's degree is a divisor of its canonical axis size (all
-    divisors are sub-axis-expressible) that also divides the dim extent
-    (reference Op::get_random_parallel_config, model.cc:276-305).
-
-    The FULL cartesian product is enumerated; only when it exceeds
-    ``max_candidates`` does a seeded uniform sample (always including the
-    all-ones config) replace it, and the cut is logged — never silent.
-    Index-based sampling keeps every corner of the space (e.g. pure-h/w
-    splits late in the product order) reachable."""
+def _per_dim_degrees(op: Op, mesh_shape: MeshShape
+                     ) -> List[Tuple[int, ...]]:
+    """THE per-op legality definition, shared by the full enumeration
+    (legal_configs) and the aligned seed (aligned_for_mesh): for each
+    output dim, the degrees that are divisors of its canonical axis size
+    (all divisors are sub-axis-expressible), divide the dim extent, and
+    are allowed by the op (reference Op::get_random_parallel_config,
+    model.cc:276-305)."""
     out_t = op.outputs[0]
     nd = out_t.num_dims
     allowed = op.parallel_dims()
@@ -96,6 +94,21 @@ def legal_configs(op: Op, mesh_shape: MeshShape,
         degs = tuple(d for d in expressible_degrees(mesh_shape[ax])
                      if out_t.shape[i] % d == 0)
         per_dim.append(degs or (1,))
+    return per_dim
+
+
+def legal_configs(op: Op, mesh_shape: MeshShape,
+                  max_candidates: int = 1024,
+                  seed: int = 0) -> List[ParallelConfig]:
+    """Legal configs for one op under a fixed mesh factorization — the
+    cartesian product of ``_per_dim_degrees``.
+
+    The FULL product is enumerated; only when it exceeds
+    ``max_candidates`` does a seeded uniform sample (always including the
+    all-ones config) replace it, and the cut is logged — never silent.
+    Index-based sampling keeps every corner of the space (e.g. pure-h/w
+    splits late in the product order) reachable."""
+    per_dim = _per_dim_degrees(op, mesh_shape)
     total = _prod(len(d) for d in per_dim)
     if total <= max_candidates:
         import itertools
@@ -124,27 +137,46 @@ def legal_configs(op: Op, mesh_shape: MeshShape,
             for dims in combos]
 
 
-def snap_config(pc: ParallelConfig, op: Op,
-                mesh_shape: MeshShape) -> ParallelConfig:
-    """Clamp an op config into a mesh factorization: keep each degree if it
-    divides the new axis size (and the dim extent), else fall back to the
-    largest expressible divisor of both."""
-    out_t = op.outputs[0]
-    axes = dim_axis_names(out_t.num_dims)
-    dims = []
-    for i, deg in enumerate(pc.dims[:out_t.num_dims]):
-        ax = axes[i] if i < len(axes) else None
-        if deg <= 1 or ax is None:
-            dims.append(1)
-            continue
-        best = 1
-        for d in expressible_degrees(mesh_shape.get(ax, 1)):
-            if deg % d == 0 and out_t.shape[i] % d == 0:
-                best = max(best, d)
-        dims.append(best)
-    dims += [1] * (out_t.num_dims - len(dims))
-    return ParallelConfig(dims=tuple(dims),
-                          device_ids=tuple(range(_prod(dims))))
+def greedy_for_mesh(layers: List[Op], mesh_shape: MeshShape, sim: Simulator,
+                    cands) -> Dict[str, ParallelConfig]:
+    """Per-op best-local-cost init for one mesh factorization: pick each
+    op's candidate minimizing its own fwd+bwd+weight-sync time.  Cross-op
+    transfer costs are ignored here — the caller ranks the resulting
+    strategies with a full simulate() — but this init is what makes
+    c/s/h/w-raised meshes REACHABLE: starting every mesh from DP-snapped
+    configs leaves the walk a many-op uphill barrier away from any hybrid
+    optimum (observed: round-3 searches always returned plain DP even
+    when the objective scored TP 2.25x better)."""
+    strat = {}
+    for op in layers:
+        best_pc, best_c = None, math.inf
+        for pc in cands(op, mesh_shape):
+            _, _, ft, bt, sync = sim._op_plan(op, {op.name: pc})
+            c = ft + bt + sync
+            if c < best_c:
+                best_pc, best_c = pc, c
+        if best_pc is None:
+            best_pc = ParallelConfig.data_parallel(
+                1, op.outputs[0].num_dims)
+        strat[op.name] = best_pc
+    return strat
+
+
+def aligned_for_mesh(layers: List[Op],
+                     mesh_shape: MeshShape) -> Dict[str, ParallelConfig]:
+    """Fully-aligned init for one mesh factorization: every op takes the
+    LARGEST legal degree on every axis (dim i splits by the full axis size
+    when divisible and allowed).  Producer/consumer partitions coincide, so
+    no transfer edges appear — the Megatron-style uniform hybrid (and the
+    shape of the reference's published Inception strategies).  Greedy's
+    per-op minima can misalign neighbors; this seed covers the aligned
+    corner greedy misses."""
+    strat = {}
+    for op in layers:
+        dims = tuple(max(degs) for degs in _per_dim_degrees(op, mesh_shape))
+        strat[op.name] = ParallelConfig(
+            dims=dims, device_ids=tuple(range(_prod(dims))))
+    return strat
 
 
 def search(layers: List[Op], num_devices: int, budget: int = 1000,
@@ -189,15 +221,53 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
         current[op.name] = ParallelConfig.data_parallel(deg, nd)
     cur_time = sim.simulate(layers, current, overlap_backward_update,
                             mesh_shape=mesh_shape)
+    # Seed strategies are ranked with the ANALYTIC simulator even when the
+    # anneal measures: greedy scans every candidate of every mesh, and
+    # microbenchmarking that whole space on-device before iteration 0
+    # would dwarf the search itself (the anneal's acceptance test still
+    # measures, so the objective is unchanged — seeds are only starts).
+    rank_sim = sim if not measure else Simulator(
+        spec=spec, num_devices=num_devices,
+        devices_per_slice=devices_per_slice, remat=remat,
+        flash_attention=flash_attention, compute_dtype=compute_dtype)
+    seed_cache: Dict[Tuple[int, ...], List] = {}
+
+    def mesh_seeds(ms: MeshShape) -> List:
+        """[(strategy, rank_time), ...] for one mesh — greedy + aligned,
+        deterministic per mesh, so computed once and reused by every
+        refactorization proposal."""
+        key = tuple(ms[a] for a in AXES)
+        if key not in seed_cache:
+            seed_cache[key] = [
+                (s, rank_sim.simulate(layers, s, overlap_backward_update,
+                                      mesh_shape=ms))
+                for s in (greedy_for_mesh(layers, ms, rank_sim, cands),
+                          aligned_for_mesh(layers, ms))]
+        return seed_cache[key]
+
+    # multi-start: rank greedy + aligned inits on EVERY mesh factorization
+    # and begin the anneal from the best (the reference's per-op configs
+    # carry no global mesh constraint, model.cc:276-305, so its walk
+    # reaches hybrids directly; our mesh-factorized space needs the
+    # cross-mesh jump seeded)
+    for ms in meshes:
+        for cand_strat, t in mesh_seeds(ms):
+            if t < cur_time:
+                current, cur_time, mesh_shape = cand_strat, t, ms
+    if measure:  # re-score the chosen start with the measuring objective
+        cur_time = sim.simulate(layers, current, overlap_backward_update,
+                                mesh_shape=mesh_shape)
     best, best_mesh, best_time = dict(current), dict(mesh_shape), cur_time
     for it in range(budget):
         if len(meshes) > 1 and rng.random() < 0.1:
-            # re-factorize the mesh; snap every op into the new axis sizes
+            # re-factorize the mesh: re-seed from the (memoized) greedy or
+            # aligned init (snapping existing degrees produces a crippled
+            # low-degree strategy that is always rejected — the round-3
+            # dead end)
             new_mesh = rng.choice(meshes)
             if tuple(new_mesh.values()) == tuple(mesh_shape.values()):
                 continue
-            proposal = {op.name: snap_config(current[op.name], op, new_mesh)
-                        for op in layers}
+            proposal = rng.choice(mesh_seeds(new_mesh))[0]
             prop_mesh = new_mesh
         else:
             op = rng.choice(layers)

@@ -87,6 +87,7 @@ class Simulator:
         self.remat = remat  # the run rematerializes: less resident memory
         self.compute_dtype = compute_dtype  # measure the run's dtype
         self._measure_cache: Dict[Tuple, Tuple[float, float]] = {}
+        self._plan_cache: Dict[Tuple, Tuple] = {}
         self._native = None
         if use_native:
             from ..native import load_ffsim
@@ -137,8 +138,16 @@ class Simulator:
     # --------------------------------------------------------------
     def _op_plan(self, op: Op, strategies) -> Tuple:
         """(pc, padded dims, fwd, bwd, sync) for one op — shared between the
-        Python and native simulators."""
+        Python and native simulators.  Cached by (op, config): the greedy
+        multi-start scans heavily-overlapping candidate sets across all
+        mesh factorizations, and a plan depends only on the op and its
+        own config."""
         pc = strategies.get(op.name)
+        key = (op.name, None if pc is None
+               else (tuple(pc.dims), tuple(pc.device_ids)))
+        hit = self._plan_cache.get(key)
+        if hit is not None:
+            return hit
         if pc is None:
             nd = op.outputs[0].num_dims
             pc = ParallelConfig.data_parallel(
@@ -181,7 +190,9 @@ class Simulator:
                     sync += allreduce_time(
                         wb, min(repl * c_deg, self.num_devices), self.spec,
                         members_per_slice=dps)
-        return pc, dims, ft, bt, sync
+        plan = (pc, dims, ft, bt, sync)
+        self._plan_cache[key] = plan
+        return plan
 
     def peak_memory_bytes(self, layers: List[Op],
                           strategies: Dict[str, ParallelConfig],

@@ -1,0 +1,98 @@
+"""Search-beats-DP evidence on real workloads (VERDICT r3 #3).
+
+The reference's thesis is that SOAP search beats data parallelism
+(model.cc:1020-1054; MLSys'19 reports up to ~3.3x).  These tests pin the
+committed artifact claims (artifacts/SEARCH_VS_DP.md): the searched
+strategy must never lose to DP on the real graphs, must STRICTLY beat it
+in the weight-heavy NMT regime (the reference's own showcase: its nmt/
+strategies shard exactly these layers), and a searched NMT strategy must
+execute on the 8-device CPU mesh.
+"""
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.config import ParallelConfig
+from flexflow_tpu.search.cost_model import V5E_SPEC
+from flexflow_tpu.search.mcmc import search
+from flexflow_tpu.search.simulator import Simulator
+
+
+def _dp(layers, ndev):
+    return {op.name: ParallelConfig.data_parallel(
+        min(ndev, op.outputs[0].shape[0]), op.outputs[0].num_dims)
+        for op in layers}
+
+
+def _nmt_model(batch=256, vocab=20000, dim=2048):
+    cfg = ff.FFConfig(batch_size=batch, compute_dtype="bfloat16")
+    from flexflow_tpu.models.nmt import build_nmt
+    model, _, _ = build_nmt(cfg, vocab_size=vocab, embed_dim=dim,
+                            hidden_dim=dim, num_layers=2,
+                            src_len=24, tgt_len=24)
+    return model
+
+
+def test_search_strictly_beats_dp_on_nmt():
+    """BASELINE config 4 dims (nmt.cc:34-44): the 2048-wide LSTM + 20k
+    vocab head is weight-sync-bound under DP — the search must find the
+    model-parallel strategy (>= 2x simulated, measured 3.66x)."""
+    model = _nmt_model()
+    sim = Simulator(spec=V5E_SPEC, num_devices=8)
+    t_dp = sim.simulate(model.layers, _dp(model.layers, 8))
+    best, best_mesh, t_best = search(model.layers, 8, budget=200, seed=0,
+                                     spec=V5E_SPEC)
+    assert t_best <= t_dp / 2, (t_best, t_dp)
+    assert best_mesh.get("c", 1) > 1  # the win is tensor parallelism
+
+
+def test_search_never_loses_to_dp_on_transformer():
+    cfg = ff.FFConfig(batch_size=8, compute_dtype="bfloat16")
+    from flexflow_tpu.models.transformer import build_transformer
+    model, _, _ = build_transformer(
+        cfg, num_layers=2, d_model=768, num_heads=12, d_ff=3072,
+        seq_len=512, vocab_size=30522, num_classes=2)
+    sim = Simulator(spec=V5E_SPEC, num_devices=8)
+    t_dp = sim.simulate(model.layers, _dp(model.layers, 8))
+    _, _, t_best = search(model.layers, 8, budget=150, seed=0,
+                          spec=V5E_SPEC)
+    assert t_best <= t_dp * 1.001
+
+
+def test_searched_nmt_strategy_executes():
+    """The searched TP strategy is not simulator fiction: compile and
+    train the (small-dims) NMT with it on the 8-device CPU mesh."""
+    model = _nmt_model(batch=16, vocab=128, dim=64)
+    cfg = model.config
+    cfg.compute_dtype = "float32"
+    best, best_mesh, _ = search(model.layers, 8, budget=100, seed=0,
+                                spec=V5E_SPEC)
+    cfg.strategies.update(best)
+    mesh = ff.MachineMesh({a: s for a, s in best_mesh.items() if s > 1})
+    for op in model.layers:
+        op.parallel_config = cfg.strategies.get(op.name)
+    model.compile(ff.SGDOptimizer(lr=0.1),
+                  ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY, [],
+                  final_tensor=model.layers[-1].outputs[0], mesh=mesh)
+    model.init_layers(seed=0)
+    rng = np.random.default_rng(0)
+    xs = rng.integers(0, 128, (16, 24)).astype(np.int32)
+    xt = rng.integers(0, 128, (16, 24)).astype(np.int32)
+    y = np.roll(xt, -1, axis=1).astype(np.int32)
+    assert np.isfinite(float(model.train_batch(xs, xt, y)))
+
+
+def test_committed_artifact_parses():
+    """The committed .pb artifacts must stay loadable and name-matched to
+    the graphs they claim to shard."""
+    import os
+    from flexflow_tpu.strategy.proto import load_strategy_file
+    pb = "artifacts/searched_nmt_b256_8dev.pb"
+    if not os.path.exists(pb):
+        pytest.skip("artifact not built")
+    strategies = load_strategy_file(pb)
+    model = _nmt_model()
+    names = {op.name for op in model.layers}
+    assert names.issubset(set(strategies))
+    assert any(max(pc.dims) > 1 for pc in strategies.values())
