@@ -77,6 +77,9 @@ HBM_BW = {
     "TPU v6e": 1640e9,
 }
 
+# internal conv layout for the built models (--conv-layout nchw|nhwc|auto)
+CONV_LAYOUT = "auto"
+
 # sweep order: headline first so an interrupted sweep still records it
 SWEEP = ["inception_v3", "alexnet", "resnet50", "nmt", "transformer",
          "dlrm", "candle_uno"]
@@ -92,6 +95,7 @@ def build(model_name: str, batch_size: int):
 
     rng = np.random.default_rng(0)
     cfg = ff.FFConfig(batch_size=batch_size, compute_dtype="bfloat16")
+    cfg.conv_layout = CONV_LAYOUT  # --conv-layout (NHWC A/B experiment)
     if model_name == "inception_v3":
         from flexflow_tpu.models.inception import build_inception_v3
         model, inp, logits = build_inception_v3(cfg, num_classes=1000,
@@ -307,6 +311,7 @@ def bench_model(model_name, batch_size, iters):
 
 
 def main():
+    global CONV_LAYOUT
     model_name = None  # default: full sweep
     batch_size = 0
     iters = 20
@@ -334,6 +339,8 @@ def main():
             budget_s = float(_val(i, a))
         if a == "--models":  # subset sweep (smoke tests)
             sweep = _val(i, a).split(",")
+        if a == "--conv-layout":
+            CONV_LAYOUT = _val(i, a).lower()
     if "--all" in args or model_name == "all":
         model_name = None
 

@@ -129,6 +129,10 @@ class FFConfig:
     mesh_shape: Optional[Dict[str, int]] = None  # explicit mesh override
     simulator_mode: str = "analytic"  # "analytic" | "measure"
     remat: bool = False  # jax.checkpoint the forward pass
+    # internal conv/pool layout: "nchw" (reference parity), "nhwc"
+    # (channels-minor = TPU lane dim), or "auto" (currently nchw until the
+    # on-chip A/B lands — flip after measurement, see BASELINE.md)
+    conv_layout: str = "auto"
     # Pallas flash-attention kernel.  None = auto: flash at s >= 1024
     # (measured on v5e: flash 2.7-2.8x faster at s=1024..3072, only
     # source of attention at s >= 8192 where the dense f32 score matrix
@@ -199,6 +203,8 @@ class FFConfig:
                 cfg.seed = int(val())
             elif a == "--remat":
                 cfg.remat = True
+            elif a == "--conv-layout":
+                cfg.conv_layout = val().lower()
             # unknown flags pass through (reference forwards Legion flags)
             i += 1
         return cfg

@@ -31,7 +31,7 @@ from . import losses as losses_mod
 from . import metrics as metrics_mod
 from .config import DeviceType, FFConfig, MemoryType, ParallelConfig
 from .initializers import GlorotUniform
-from .op import Op, OpContext, OpType
+from .op import Op, OpContext, OpType, resolve_conv_layout
 from .optimizers import Optimizer, SGDOptimizer
 from .ops.conv import Conv2D, Pool2D
 from .ops.elementwise import ElementBinary, ElementUnary
@@ -493,10 +493,13 @@ class FFModel:
         loss_uid = self._loss_tensor.uid
         final_uid = self._final_tensor.uid
 
+        conv_layout = resolve_conv_layout(cfg.conv_layout)
+
         def forward_full(params, batch, rng, training):
             ctx = OpContext(training=training, rng=rng,
                             compute_dtype=cfg.compute_dtype, mesh=self.mesh,
-                            flash_attention=cfg.flash_attention)
+                            flash_attention=cfg.flash_attention,
+                            conv_layout=conv_layout)
             inputs = {uid: x for uid, x in zip(input_uids, batch[:-1])}
             values = self._forward_values(params, inputs, ctx)
             aux = sum(ctx.aux_losses.values()) if ctx.aux_losses else 0.0

@@ -55,6 +55,17 @@ class OpType(enum.Enum):
     INPUT = "input"
 
 
+def resolve_conv_layout(value: str) -> str:
+    """Normalize + validate a conv_layout setting.  A typo must FAIL, not
+    silently run NCHW — an A/B whose 'nhwc' arm silently benchmarks nchw
+    records a bogus no-difference result."""
+    v = (value or "auto").lower()
+    if v not in ("nchw", "nhwc", "auto"):
+        raise ValueError(
+            f"conv_layout must be 'nchw', 'nhwc' or 'auto', got {value!r}")
+    return "nchw" if v == "auto" else v  # auto: pending the on-chip A/B
+
+
 def pad_degrees(part_degrees, rank: int):
     """Output partition degrees padded/truncated to ``rank`` dims — the
     one shared idiom for aligning a strategy's degree tuple to a tensor's
@@ -81,6 +92,12 @@ class OpContext:
     # Pallas flash attention: None = auto (flash at s >= 1024 on TPU,
     # the measured v5e crossover — see FFConfig.flash_attention)
     flash_attention: Optional[bool] = None
+    # internal conv/pool layout: "nchw" (reference parity, default) or
+    # "nhwc" (channels-minor: TPU lane dimension; FFConfig.conv_layout).
+    # Tensor METADATA stays NCHW either way — ops transpose at their own
+    # boundaries, and XLA cancels the back-to-back pairs between
+    # conv/pool neighbors.
+    conv_layout: str = "nchw"
     # functional state updates: ops write {param_name: new_value} here for
     # non-trainable state (batchnorm running stats); the train step returns
     # them as part of the new params pytree

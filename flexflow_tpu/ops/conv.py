@@ -59,17 +59,30 @@ class Conv2D(Op):
         x = cast_compute(inputs[0], ctx)
         k = cast_compute(params[self.w_kernel.name], ctx)
         ph, pw = self.padding
+        # "nhwc": channels-minor — the TPU lane dimension (pallas_guide:
+        # last dim -> 128 lanes).  Convert at this op's boundary; adjacent
+        # conv/pool transposes cancel in XLA, so a conv trunk pays only
+        # the graph-edge conversions, and bias/relu fuse as a last-axis
+        # epilogue (VERDICT r3 #2 experiment).
+        nhwc = ctx.conv_layout == "nhwc"
+        if nhwc:
+            x = jnp.transpose(x, (0, 2, 3, 1))
+            k = jnp.transpose(k, (2, 3, 1, 0))  # OIHW -> HWIO
         # no explicit preferred_element_type: the MXU accumulates bf16 convs
         # in f32 natively, and JAX's conv transpose rule rejects mixed
         # operand/accumulator dtypes in the backward pass
         y = lax.conv_general_dilated(
             x, k, window_strides=self.stride,
             padding=[(ph, ph), (pw, pw)],
-            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            dimension_numbers=(("NHWC", "HWIO", "NHWC") if nhwc
+                               else ("NCHW", "OIHW", "NCHW")),
             feature_group_count=self.groups)
         if self.use_bias:
-            y = y + params[self.w_bias.name].astype(y.dtype).reshape(1, -1, 1, 1)
+            b = params[self.w_bias.name].astype(y.dtype)
+            y = y + (b if nhwc else b.reshape(1, -1, 1, 1))
         y = apply_activation(y, self.activation)
+        if nhwc:
+            y = jnp.transpose(y, (0, 3, 1, 2))
         return [cast_compute(y, ctx)]
 
     def parallel_dims(self):
@@ -133,9 +146,15 @@ class Pool2D(Op):
     def forward(self, params, inputs, ctx: OpContext):
         x = cast_compute(inputs[0], ctx)
         ph, pw = self.padding
-        window = (1, 1) + self.kernel
-        strides = (1, 1) + self.stride
-        padding = ((0, 0), (0, 0), (ph, ph), (pw, pw))
+        if ctx.conv_layout == "nhwc":  # window over dims 1,2; lanes last
+            x = jnp.transpose(x, (0, 2, 3, 1))
+            window = (1,) + self.kernel + (1,)
+            strides = (1,) + self.stride + (1,)
+            padding = ((0, 0), (ph, ph), (pw, pw), (0, 0))
+        else:
+            window = (1, 1) + self.kernel
+            strides = (1, 1) + self.stride
+            padding = ((0, 0), (0, 0), (ph, ph), (pw, pw))
         if self.pool_type == "max":
             init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
             y = lax.reduce_window(x, init, lax.max, window, strides, padding)
@@ -143,6 +162,8 @@ class Pool2D(Op):
             s = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
             y = s / (self.kernel[0] * self.kernel[1])
         y = apply_activation(y, self.activation)
+        if ctx.conv_layout == "nhwc":
+            y = jnp.transpose(y, (0, 3, 1, 2))
         return [y]
 
     def parallel_dims(self):

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .op import Op, OpContext
+from .op import Op, OpContext, resolve_conv_layout
 
 
 class _NoFloatLeaf(ValueError):
@@ -58,16 +58,18 @@ def _init_params(op: Op, seed: int = 0, shapes=None) -> Dict[str, jax.Array]:
 
 def profile_op(op: Op, compute_dtype: str = "bfloat16", warmup: int = 2,
                iters: int = 5, flash_attention=None, input_shapes=None,
-               weight_shapes=None) -> Dict[str, float]:
+               weight_shapes=None, conv_layout: str = "auto"
+               ) -> Dict[str, float]:
     """(fwd_ms, bwd_ms) for one op, timed in isolation (reference
     measure_compute_time contract: returns per-config latency).  The ctx
-    mirrors the run's kernel choices (flash_attention) so the numbers match
-    what fit() actually executes.  ``input_shapes``/``weight_shapes``
-    override the declared shapes — the simulator's measure mode times one
-    PARTITION of the op this way (Op.sub_problem)."""
+    mirrors the run's kernel choices (flash_attention, conv_layout) so the
+    numbers match what fit() actually executes.  ``input_shapes``/
+    ``weight_shapes`` override the declared shapes — the simulator's
+    measure mode times one PARTITION of the op this way (Op.sub_problem)."""
     ctx = OpContext(training=True, rng=jax.random.PRNGKey(0),
                     compute_dtype=compute_dtype,
-                    flash_attention=flash_attention)
+                    flash_attention=flash_attention,
+                    conv_layout=resolve_conv_layout(conv_layout))
     params = _init_params(op, shapes=weight_shapes)
     inputs = _example_inputs(op, shapes=input_shapes)
 
@@ -208,7 +210,8 @@ def profile_model(model, file=None) -> List[Dict[str, float]]:
           file=file)
     for op in model.layers:
         r = profile_op(op, model.config.compute_dtype,
-                       flash_attention=model.config.flash_attention)
+                       flash_attention=model.config.flash_attention,
+                       conv_layout=model.config.conv_layout)
         rows.append({"name": op.name, **r})
         print(f"{op.name:30s} {op.op_type.value:14s} "
               f"{r['fwd_ms']:9.3f} {r['bwd_ms']:9.3f}", file=file)

@@ -185,7 +185,7 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
            overlap_backward_update: bool = False,
            verbose: bool = False, flash_attention=None,
            devices_per_slice: int = 0, remat: bool = False,
-           compute_dtype: str = "bfloat16"
+           compute_dtype: str = "bfloat16", conv_layout: str = "auto"
            ) -> Tuple[Dict[str, ParallelConfig], MeshShape, float]:
     """Run the annealing loop; returns (best strategies, best mesh
     factorization, best simulated time).  ``devices_per_slice`` < the
@@ -196,7 +196,7 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
     sim = Simulator(spec=spec, num_devices=num_devices, measure=measure,
                     flash_attention=flash_attention,
                     devices_per_slice=devices_per_slice, remat=remat,
-                    compute_dtype=compute_dtype)
+                    compute_dtype=compute_dtype, conv_layout=conv_layout)
     meshes = candidate_meshes(num_devices)
 
     def dp_mesh() -> MeshShape:
@@ -229,7 +229,8 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
     rank_sim = sim if not measure else Simulator(
         spec=spec, num_devices=num_devices,
         devices_per_slice=devices_per_slice, remat=remat,
-        flash_attention=flash_attention, compute_dtype=compute_dtype)
+        flash_attention=flash_attention, compute_dtype=compute_dtype,
+        conv_layout=conv_layout)
     seed_cache: Dict[Tuple[int, ...], List] = {}
 
     def mesh_seeds(ms: MeshShape) -> List:
@@ -320,7 +321,7 @@ def optimize_strategies(model, cfg: FFConfig) -> Dict[str, ParallelConfig]:
         overlap_backward_update=cfg.search_overlap_backward_update,
         flash_attention=cfg.flash_attention,
         devices_per_slice=dps, remat=cfg.remat,
-        compute_dtype=cfg.compute_dtype)
+        compute_dtype=cfg.compute_dtype, conv_layout=cfg.conv_layout)
     print(f"[search] best simulated iteration time: {best_time * 1e3:.3f} ms "
           f"on {ndev} devices, mesh "
           f"{ {a: s for a, s in best_mesh.items() if s > 1} }")

@@ -77,7 +77,8 @@ class Simulator:
                  num_devices: int = 1, devices_per_slice: int = 0,
                  measure: bool = False, dtype_bytes: int = 2,
                  use_native: bool = True, flash_attention=None,
-                 remat: bool = False, compute_dtype: str = "bfloat16"):
+                 remat: bool = False, compute_dtype: str = "bfloat16",
+                 conv_layout: str = "auto"):
         self.spec = spec if spec is not None else spec_for_device()
         self.num_devices = num_devices
         self.devices_per_slice = devices_per_slice or num_devices
@@ -86,6 +87,7 @@ class Simulator:
         self.flash_attention = flash_attention  # measure the run's kernels
         self.remat = remat  # the run rematerializes: less resident memory
         self.compute_dtype = compute_dtype  # measure the run's dtype
+        self.conv_layout = conv_layout  # ... and the run's conv layout
         self._measure_cache: Dict[Tuple, Tuple[float, float]] = {}
         self._plan_cache: Dict[Tuple, Tuple] = {}
         self._native = None
@@ -120,7 +122,8 @@ class Simulator:
         try:
             r = profile_op(op, compute_dtype=self.compute_dtype,
                            flash_attention=self.flash_attention,
-                           input_shapes=in_shapes, weight_shapes=w_shapes)
+                           input_shapes=in_shapes, weight_shapes=w_shapes,
+                           conv_layout=self.conv_layout)
         except Exception:
             return (float("inf"),) * 2
         fwd = r["fwd_ms"] * 1e-3
