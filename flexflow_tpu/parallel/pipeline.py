@@ -89,14 +89,25 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: MachineMesh,
                    schedule: str = "gpipe",
                    virtual_stages: Optional[int] = None):
     """Run the stacked stages over ``x`` as a collective pipeline.
+    Returns ``(y, aux)`` — aux is the per-batch sum of the stages'
+    auxiliary losses (0 when stage_fn returns a bare array).
 
-    stage_fn(params, x) -> y with y.shape == x.shape (homogeneous stages);
+    stage_fn(params, x) -> y [or (y, aux_scalar)] with y.shape == x.shape
+    (shape-homogeneous stages; the stage BODY is arbitrary — see
+    ops/pipeline.PipelineSegment for stages built from any FFModel
+    subgraph, including MoE);
     ``stacked_params``: pytree whose leaves carry a leading stage dim,
-    sharded over the mesh's ``p`` axis.  x: (n, ...) activations (may be
-    sharded over ``n``); returns same-shaped y.  ``schedule``: "gpipe" or
-    "interleaved"; the latter REQUIRES ``virtual_stages`` (chunks per
-    rank), which pins the traversal order mesh-independently — the p==1
-    fallback then reproduces the pipelined numerics exactly.
+    sharded over the mesh's ``p`` axis.  x: (n, ...) activations; returns
+    same-shaped y.  ``schedule``: "gpipe" or "interleaved"; the latter
+    REQUIRES ``virtual_stages`` (chunks per rank), which pins the
+    traversal order mesh-independently — the p==1 fallback then
+    reproduces the pipelined numerics exactly.
+
+    Only the ``p`` sub-axes are MANUAL in the shard_map — every other
+    mesh axis stays auto, so activations keep their ``n`` (data) sharding
+    and stage bodies may carry ``c`` (tensor) and ``e`` (expert) sharding
+    constraints inside: GSPMD inserts the TP/MoE collectives within each
+    pipeline rank.  This is what composes {n, c, e, p} in one program.
     """
     assert schedule in ("gpipe", "interleaved"), schedule
     leaves = jax.tree.leaves(stacked_params)
@@ -104,6 +115,11 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: MachineMesh,
     for leaf in leaves:
         assert leaf.shape[0] == total_stages, \
             "all stacked leaves must share the stage dim"
+
+    def sfn(params, h):  # normalize: stages may or may not emit aux
+        r = stage_fn(params, h)
+        return r if isinstance(r, tuple) else (r, jnp.float32(0.0))
+
     if schedule == "interleaved":
         if not virtual_stages or total_stages % virtual_stages != 0:
             raise ValueError(
@@ -121,10 +137,11 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: MachineMesh,
             range(total_stages)) else stacked_params
 
         def body(h, params):
-            return stage_fn(params, h), None
+            y, aux = sfn(params, h)
+            return y, aux
 
-        y, _ = lax.scan(body, x, ordered)
-        return y
+        y, auxs = lax.scan(body, x, ordered)
+        return y, jnp.sum(auxs)
 
     if total_stages % S != 0:
         raise ValueError(
@@ -136,24 +153,26 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: MachineMesh,
             f"needs mesh p == {S_eff}, got {S}")
     M = num_microbatches or S
     p_axes = mesh.subaxes("p")
-    n_axes = mesh.subaxes("n")
-    n_sharded = bool(n_axes) and x.shape[0] % (mesh.axis_size("n") * M) == 0
-    x_spec = PartitionSpec(n_axes if n_sharded else None,
-                           *([None] * (x.ndim - 1)))
+    # activations enter with their data (n) sharding intact on the AUTO
+    # axes; only the stage dim of the weights is a manual (p) spec
+    x_spec = PartitionSpec(*([None] * x.ndim))
     pspec = jax.tree.map(
         lambda a: PartitionSpec(p_axes, *([None] * (a.ndim - 1))),
         stacked_params)
 
     if schedule == "interleaved":
         v = virtual_stages
-        fn = partial(_pipeline_interleaved_local, stage_fn=stage_fn, S=S,
+        fn = partial(_pipeline_interleaved_local, stage_fn=sfn, S=S,
                      M=M, v=v, p_axes=p_axes,
                      ticks=_interleaved_ticks(S, M, v))
     else:
-        fn = partial(_pipeline_local, stage_fn=stage_fn, S=S, M=M,
+        fn = partial(_pipeline_local, stage_fn=sfn, S=S, M=M,
                      p_axes=p_axes)
-    return jax.shard_map(fn, mesh=mesh.mesh, in_specs=(pspec, x_spec),
-                         out_specs=x_spec, check_vma=False)(stacked_params, x)
+    y, aux = jax.shard_map(
+        fn, mesh=mesh.mesh, in_specs=(pspec, x_spec),
+        out_specs=(x_spec, PartitionSpec()), check_vma=False,
+        axis_names=frozenset(p_axes))(stacked_params, x)
+    return y, aux
 
 
 def _pipeline_interleaved_local(stacked_local, x_loc, *, stage_fn, S: int,
@@ -174,9 +193,10 @@ def _pipeline_interleaved_local(stacked_local, x_loc, *, stage_fn, S: int,
     mb0 = jnp.asarray(0, jnp.int32)
     inj0 = jnp.asarray(0, jnp.int32)    # next microbatch to inject (rank 0)
     out0 = jnp.zeros_like(xm)
+    aux0 = jnp.float32(0.0)
 
     def tick(carry, _):
-        x_arr, tag, mb, inj, out = carry
+        x_arr, tag, mb, inj, out, aux = carry
         can_inject = (idx == 0) & (tag < 0) & (inj < M)
         x_in = jnp.where(can_inject, xm[jnp.clip(inj, 0, M - 1)], x_arr)
         tag = jnp.where(can_inject, 0, tag)
@@ -186,8 +206,10 @@ def _pipeline_interleaved_local(stacked_local, x_loc, *, stage_fn, S: int,
             lambda a: lax.dynamic_index_in_dim(
                 a, jnp.clip(tag, 0, v - 1), 0, keepdims=False),
             stacked_local)
-        y = stage_fn(chunk_params, x_in).astype(x_in.dtype)
+        y, a = stage_fn(chunk_params, x_in)
+        y = y.astype(x_in.dtype)
         y = jnp.where(tag >= 0, y, x_in)    # idle tick: pass-through mask
+        aux = aux + jnp.where(tag >= 0, a, 0.0)  # idle ticks chew garbage
         is_final = (idx == S - 1) & (tag == v - 1)
         emitted = out.at[jnp.clip(mb, 0, M - 1)].set(y)
         out = jnp.where(is_final & (tag >= 0), emitted, out)
@@ -200,12 +222,18 @@ def _pipeline_interleaved_local(stacked_local, x_loc, *, stage_fn, S: int,
         x_nxt = lax.ppermute(y, p_axes, ring)
         tag_nxt = lax.ppermute(send_tag, p_axes, ring)
         mb_nxt = lax.ppermute(mb, p_axes, ring)
-        return (x_nxt, tag_nxt, mb_nxt, inj, out), None
+        return (x_nxt, tag_nxt, mb_nxt, inj, out, aux), None
 
-    (_, _, _, _, out), _ = lax.scan(tick, (x0, tag0, mb0, inj0, out0),
-                                    jnp.arange(ticks))
+    (_, _, _, _, out, aux), _ = lax.scan(
+        tick, (x0, tag0, mb0, inj0, out0, aux0), jnp.arange(ticks))
     out = lax.psum(jnp.where(idx == S - 1, out, jnp.zeros_like(out)), p_axes)
-    return out.reshape(x_loc.shape)
+    # /M rescales the M per-microbatch aux terms to the p==1 fallback's
+    # full-batch scale.  EXACT only for batch-linear aux (plain means);
+    # nonlinear statistics like MoE's sum_e f_e*P_e load-balance loss
+    # differ from the full-batch value by O(microbatch variance) — parity
+    # tests against p==1 need a tolerance, not exactness.
+    aux = lax.psum(aux, p_axes) / M
+    return out.reshape(x_loc.shape), aux
 
 
 def _pipeline_local(stacked_local, x_loc, *, stage_fn, S: int, M: int,
@@ -225,24 +253,33 @@ def _pipeline_local(stacked_local, x_loc, *, stage_fn, S: int, M: int,
     def run_group(x_in):
         # scan this rank's local stage group in order
         def body(h, params):
-            return stage_fn(params, h).astype(h.dtype), None
+            y, a = stage_fn(params, h)
+            return y.astype(h.dtype), a
 
-        y, _ = lax.scan(body, x_in, stacked_local)
-        return y
+        y, auxs = lax.scan(body, x_in, stacked_local)
+        return y, jnp.sum(auxs)
 
     def tick(carry, t):
-        state, out = carry
+        state, out, aux = carry
         mb_in = xm[jnp.clip(t, 0, M - 1)]
         x_in = jnp.where(idx == 0, mb_in, state)
-        y = run_group(x_in).astype(state.dtype)
+        y, a = run_group(x_in)
+        y = y.astype(state.dtype)
+        # this rank computes real data only at ticks idx <= t < idx + M;
+        # bubble ticks chew zeros whose aux must not count
+        aux = aux + jnp.where((t >= idx) & (t < idx + M), a, 0.0)
         m = t - (S - 1)  # microbatch the LAST stage just finished
         emitted = out.at[jnp.clip(m, 0, M - 1)].set(y)
         valid = (idx == S - 1) & (m >= 0)
         out = jnp.where(valid, emitted, out)
         state = lax.ppermute(y, p_axes, perm)
-        return (state, out), None
+        return (state, out, aux), None
 
-    (state, out), _ = lax.scan(tick, (state0, out0), jnp.arange(S + M - 1))
+    (state, out, aux), _ = lax.scan(tick, (state0, out0, jnp.float32(0.0)),
+                                    jnp.arange(S + M - 1))
     # only the last rank holds real outputs; broadcast around the ring
     out = lax.psum(jnp.where(idx == S - 1, out, jnp.zeros_like(out)), p_axes)
-    return out.reshape(x_loc.shape)
+    # /M rescales per-microbatch aux to full-batch scale (exact only for
+    # batch-linear aux — see the interleaved loop's note)
+    aux = lax.psum(aux, p_axes) / M
+    return out.reshape(x_loc.shape), aux

@@ -71,11 +71,23 @@ def param_spec(param: Parameter, pc: Optional[ParallelConfig],
     if param.shard_axis in ("p", "e"):
         # stage-stacked (pipeline) / expert-stacked (MoE) weights shard
         # their leading stack dim over the dedicated mesh axis
-        if param.sharded_dim is None or mesh.axis_size(param.shard_axis) <= 1:
-            return PartitionSpec()
         entries = [None] * len(param.shape)
-        entries[param.sharded_dim] = param.shard_axis
-        return PartitionSpec(*entries)
+        if (param.sharded_dim is not None
+                and mesh.axis_size(param.shard_axis) > 1):
+            entries[param.sharded_dim] = param.shard_axis
+        # a pipeline-stacked weight may carry a SECOND in-stage sharding
+        # (c-TP linear or e-stacked MoE expert dim inside a stage) — the
+        # {n,c,e,p} composition
+        idim = param.inner_sharded_dim
+        if (idim is not None and idim < len(param.shape)
+                and mesh.axis_size(param.inner_shard_axis) > 1
+                and param.shape[idim] % mesh.axis_size(
+                    param.inner_shard_axis) == 0
+                and entries[idim] is None):
+            entries[idim] = param.inner_shard_axis
+        if any(e is not None for e in entries):
+            return PartitionSpec(*entries)
+        return PartitionSpec()
     if (pc is None or param.sharded_dim is None
             or mesh.axis_size("c") <= 1):
         return PartitionSpec()

@@ -85,6 +85,11 @@ class Parameter(Tensor):
     # mesh axis the sharded_dim maps to: "c" (tensor parallel, default) or
     # "p" (pipeline-stage-stacked weights, parallel/pipeline.py)
     shard_axis: str = "c"
+    # stage-stacked weights only: a SECOND sharded dim inside the stage
+    # slice ("c" tensor parallel or "e" expert parallel within a pipeline
+    # stage — the {n,c,e,p} composition, ops/pipeline.PipelineSegment)
+    inner_sharded_dim: Optional[int] = None
+    inner_shard_axis: str = "c"
     # False for op state (e.g. batchnorm running stats): excluded from the
     # optimizer, updated functionally via OpContext.updates
     trainable: bool = True

@@ -135,8 +135,8 @@ def test_interleaved_pipeline_matches_reference_order():
 
     x = jnp.asarray(rng.standard_normal((16, 8)).astype(np.float32))
     mesh = MachineMesh({"p": S})
-    y_pipe = pipeline_apply(stage, params, x, mesh, num_microbatches=M,
-                            schedule="interleaved", virtual_stages=v)
+    y_pipe, _ = pipeline_apply(stage, params, x, mesh, num_microbatches=M,
+                               schedule="interleaved", virtual_stages=v)
     # reference: sequential application in the schedule's traversal order
     ref = x
     for s_idx in traversal_order(L, S, "interleaved"):
@@ -148,7 +148,7 @@ def test_interleaved_pipeline_matches_reference_order():
         return jnp.sum(pipeline_apply(stage, params, x, mesh,
                                       num_microbatches=M,
                                       schedule="interleaved",
-                                      virtual_stages=v) ** 2)
+                                      virtual_stages=v)[0] ** 2)
 
     g = jax.grad(loss)(params)
     assert float(jnp.abs(g["w"]).max()) > 0

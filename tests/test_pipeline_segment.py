@@ -1,0 +1,135 @@
+"""PipelineSegment — heterogeneous pipeline stages (VERDICT r3 #6).
+
+A stage is an ARBITRARY FFModel subgraph (here: dense TP layers + MoE),
+pipelined over 'p' and composed with data (n), tensor (c) and expert (e)
+sharding in one program.  Parity: the p==1 fallback runs the same stacked
+weights through a lax.scan, so single-device and pipelined runs must agree
+step for step (MoE aux is microbatch-mean-rescaled, hence the tolerance).
+"""
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.parallel.mesh import MachineMesh
+
+N, S, D = 8, 4, 16
+
+
+def _stage_dense(seg, t):
+    h = seg.dense(t, 32, activation="relu")
+    return seg.dense(h, D)
+
+
+def _stage_moe(seg, t):
+    h = seg.dense(t, 32, activation="relu")
+    h = seg.dense(h, D)
+    # capacity_factor 4: no token drops, so microbatching cannot change
+    # routing outcomes and parity stays tight
+    return seg.moe(h, num_experts=2, d_ff=32, k=1, capacity_factor=4.0,
+                   aux_loss_weight=1e-2)
+
+
+def _build(mesh_shape, stage, M=2, stages=2, schedule="gpipe",
+           virtual_stages=None):
+    cfg = ff.FFConfig(batch_size=N, compute_dtype="float32")
+    model = ff.FFModel(cfg)
+    x = model.create_tensor((N, S, D), name="x")
+    t = model.pipeline(x, num_stages=stages, stage_builder=stage,
+                       num_microbatches=M, schedule=schedule,
+                       virtual_stages=virtual_stages)
+    t = model.reshape(t, (N, S * D))
+    logits = model.dense(t, 4)
+    model.compile(ff.SGDOptimizer(lr=0.2),
+                  "sparse_categorical_crossentropy", [],
+                  final_tensor=logits, mesh=MachineMesh(mesh_shape))
+    model.init_layers(seed=0)
+    return model
+
+
+def _train(mesh_shape, stage, steps=4, **kw):
+    model = _build(mesh_shape, stage, **kw)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((N, S, D)).astype(np.float32)
+    y = rng.integers(0, 4, (N, 1)).astype(np.int32)
+    return [float(model.train_batch(x, y)) for _ in range(steps)]
+
+
+def test_segment_parity_dense_stage():
+    base = _train({"n": 1}, _stage_dense)
+    pp = _train({"p": 2}, _stage_dense)
+    np.testing.assert_allclose(base, pp, rtol=1e-4)
+    assert base[-1] < base[0]
+
+
+def test_segment_parity_moe_stage():
+    """The verdict composition: MoE inside pipelined stages, with DP and
+    EP raised alongside the pipeline — vs the single-device run."""
+    base = _train({"n": 1}, _stage_moe)
+    pp = _train({"n": 2, "e": 2, "p": 2}, _stage_moe)
+    np.testing.assert_allclose(base, pp, rtol=2e-3)
+    assert base[-1] < base[0]
+
+
+def test_segment_interleaved_schedule():
+    base = _train({"n": 1}, _stage_dense, stages=4, schedule="interleaved",
+                  virtual_stages=2)
+    pp = _train({"p": 2}, _stage_dense, stages=4, schedule="interleaved",
+                virtual_stages=2)
+    np.testing.assert_allclose(base, pp, rtol=1e-4)
+
+
+def test_segment_rejects_shape_changing_stage():
+    cfg = ff.FFConfig(batch_size=N, compute_dtype="float32")
+    model = ff.FFModel(cfg)
+    x = model.create_tensor((N, S, D), name="x")
+    with pytest.raises(ValueError, match="ring invariance"):
+        model.pipeline(x, 2, lambda seg, t: seg.dense(t, D + 1))
+
+
+def test_segment_weights_stacked_and_stage_sharded():
+    model = _build({"p": 2}, _stage_dense)
+    stacked = [p for p in model.parameters if p.shard_axis == "p"]
+    assert stacked, "segment weights must stack over the stage dim"
+    for p in stacked:
+        assert p.shape[0] == 2
+    # inner TP dim recorded for in-stage c sharding
+    kernels = [p for p in stacked if p.name.endswith("/kernel")]
+    assert kernels and all(p.inner_sharded_dim == 1 for p in kernels)
+
+
+@pytest.mark.slow
+def test_full_ncep_composition_16dev():
+    """{n,c,e,p} ALL > 1 in one program: 16 virtual devices in a fresh
+    process (the in-process mesh is pinned to 8 by conftest)."""
+    import os
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ,
+               XLA_FLAGS="--xla_force_host_platform_device_count=16")
+    code = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
+            "import __graft_entry__ as g; g.dryrun_multichip(16)")
+    p = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, env=env, cwd=root, timeout=1200)
+    assert p.returncode == 0, p.stderr[-3000:]
+    assert "composed pipeline-segment MoE [n2 x e2 x p2 x c2]" in p.stdout
+
+
+def test_segment_moe_aux_loss_surfaces():
+    """The stage's MoE load-balance aux must reach the training loss
+    (accumulated across microbatches/stages, masked against bubbles)."""
+    model_with = _build({"p": 2}, _stage_moe)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((N, S, D)).astype(np.float32)
+    y = rng.integers(0, 4, (N, 1)).astype(np.int32)
+    l_with = float(model_with.train_batch(x, y))
+    # same graph, aux weight 0: loss must differ by exactly the aux term
+    def stage_no_aux(seg, t):
+        h = seg.dense(t, 32, activation="relu")
+        h = seg.dense(h, D)
+        return seg.moe(h, num_experts=2, d_ff=32, k=1, capacity_factor=4.0,
+                       aux_loss_weight=0.0)
+    model_wo = _build({"p": 2}, stage_no_aux)
+    l_wo = float(model_wo.train_batch(x, y))
+    assert l_with > l_wo  # aux > 0 for any imbalanced routing
