@@ -21,12 +21,11 @@ def test_capi_builds_and_trains():
     r = subprocess.run(["make", "-C", CAPI], capture_output=True, text=True,
                        timeout=300)
     assert r.returncode == 0, r.stderr[-2000:]
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    # a pre-registered PJRT plugin can override JAX_PLATFORMS; this forces
-    # the backend via jax.config inside the embedded runtime (and keeps the
-    # test off a TPU another process may hold)
-    env["FLEXFLOW_PLATFORM"] = "cpu"
+    from tests.subproc import cached_env
+    # FLEXFLOW_PLATFORM forces the backend via jax.config inside the
+    # embedded runtime (a pre-registered PJRT plugin can override
+    # JAX_PLATFORMS) and keeps the test off a TPU another process may hold
+    env = cached_env()
     paths = [REPO] + site.getsitepackages()
     env["PYTHONPATH"] = ":".join(paths + [env.get("PYTHONPATH", "")])
     out = subprocess.run([os.path.join(CAPI, "test_capi")], cwd=CAPI,

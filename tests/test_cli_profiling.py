@@ -52,8 +52,8 @@ def test_cli_runs_script_with_default_config(tmp_path):
             rng.integers(0, 4, (16, 1)).astype(np.int32))
         print("CLI_OK", float(loss))
     """))
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
+    from tests.subproc import cached_env
+    env = cached_env()
     out = subprocess.run(
         [sys.executable, "-m", "flexflow_tpu.cli", str(script),
          "-b", "16", "-e", "2"],

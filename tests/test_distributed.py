@@ -27,10 +27,10 @@ def _free_port() -> int:
 @pytest.mark.slow
 def test_two_process_mesh_trains_and_resumes(tmp_path):
     port = _free_port()
-    env = dict(os.environ)
+    from tests.subproc import cached_env
+    env = cached_env()
     env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
     env.pop("XLA_FLAGS", None)  # worker sets its own device count
-    env["FLEXFLOW_PLATFORM"] = "cpu"
     procs = [
         subprocess.Popen(
             [sys.executable, os.path.join(REPO, "tests", "_dist_worker.py"),

@@ -41,9 +41,8 @@ def test_candle_uno_trains():
 
 
 def _run_example(script, *extra):
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["FLEXFLOW_PLATFORM"] = "cpu"
+    from tests.subproc import cached_env
+    env = cached_env()
     out = subprocess.run(
         [sys.executable, "-m", "flexflow_tpu.cli", os.path.join(REPO, script),
          *extra],
@@ -58,10 +57,15 @@ def _run_example(script, *extra):
     "examples/python/native/mnist_mlp_attach.py",
     "examples/python/native/tensor_attach.py",
     "examples/python/native/print_input.py",
-    "examples/python/native/alexnet_torch.py",
 ])
 def test_native_example_scripts_run(script):
     _run_example(script, "-b", "32", "-e", "1")
+
+
+@pytest.mark.slow  # full 224x224 AlexNet compile via the torch shim
+def test_alexnet_torch_example_runs():
+    _run_example("examples/python/native/alexnet_torch.py", "-b", "32",
+                 "-e", "1")
 
 
 @pytest.mark.parametrize("script", [

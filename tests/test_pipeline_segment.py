@@ -106,8 +106,9 @@ def test_full_ncep_composition_16dev():
     import subprocess
     import sys
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = dict(os.environ,
-               XLA_FLAGS="--xla_force_host_platform_device_count=16")
+    from tests.subproc import cached_env
+    env = cached_env(
+        XLA_FLAGS="--xla_force_host_platform_device_count=16")
     code = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
             "import __graft_entry__ as g; g.dryrun_multichip(16)")
     p = subprocess.run([sys.executable, "-c", code], capture_output=True,
