@@ -77,8 +77,11 @@ HBM_BW = {
     "TPU v6e": 1640e9,
 }
 
-# internal conv layout for the built models (--conv-layout nchw|nhwc|auto)
+# internal conv layout for the built models (--conv-layout nchw|nhwc|auto).
+# "auto" resolves per model from the round-4 on-chip A/B (BASELINE.md):
+# NHWC wins on Inception (+1.4 MFU pts), regresses ResNet-50, flat AlexNet.
 CONV_LAYOUT = "auto"
+BEST_LAYOUT = {"inception_v3": "nhwc"}
 
 # sweep order: headline first so an interrupted sweep still records it
 SWEEP = ["inception_v3", "alexnet", "resnet50", "nmt", "transformer",
@@ -95,7 +98,8 @@ def build(model_name: str, batch_size: int):
 
     rng = np.random.default_rng(0)
     cfg = ff.FFConfig(batch_size=batch_size, compute_dtype="bfloat16")
-    cfg.conv_layout = CONV_LAYOUT  # --conv-layout (NHWC A/B experiment)
+    cfg.conv_layout = (BEST_LAYOUT.get(model_name, "nchw")
+                       if CONV_LAYOUT == "auto" else CONV_LAYOUT)
     if model_name == "inception_v3":
         from flexflow_tpu.models.inception import build_inception_v3
         model, inp, logits = build_inception_v3(cfg, num_classes=1000,
@@ -301,6 +305,7 @@ def bench_model(model_name, batch_size, iters):
         "mfu": round(achieved / peak, 4) if peak else None,
         "batch_size": batch_size,
         "loss": round(final_loss, 4),
+        "conv_layout": model.config.conv_layout,
     }
     if model_name == "dlrm":
         bw = HBM_BW.get(kind)

@@ -105,7 +105,10 @@ def profile_op(op: Op, compute_dtype: str = "bfloat16", warmup: int = 2,
                   if (params or float_in) else fwd_ms)
     except Exception:
         tot_ms = float("nan")  # non-differentiable op (e.g. int gather only)
-    return {"fwd_ms": fwd_ms, "bwd_ms": max(0.0, tot_ms - fwd_ms)}
+    # NaN must survive: max(0.0, nan - fwd) silently yields 0.0 in Python,
+    # which misreports a failed backward as a free one
+    bwd_ms = float("nan") if tot_ms != tot_ms else max(0.0, tot_ms - fwd_ms)
+    return {"fwd_ms": fwd_ms, "bwd_ms": bwd_ms}
 
 
 def _fence(out):

@@ -15,9 +15,11 @@ Results are recorded in BASELINE.md ("Cost-model calibration").
 """
 
 import math
+import os
 import sys
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
 
 import numpy as np
 
@@ -58,6 +60,13 @@ def build_ops():
 
 
 def main():
+    # the tunnel can make jax.devices() hang forever (BENCH_r03 failure
+    # mode) — probe in a killable subprocess first, like bench.py
+    from bench import probe_backend
+    probe = probe_backend()
+    if "error" in probe:
+        print(f"backend unavailable: {probe['error']}", flush=True)
+        raise SystemExit(1)
     import jax
     kind = jax.devices()[0].device_kind
     spec = spec_for_device(kind)

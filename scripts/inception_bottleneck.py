@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Where does Inception-v3's step time go?  (VERDICT r3 #2 trace analysis)
+
+Profiles every op of the b128 bf16 Inception graph in isolation on the
+attached chip (profiling.profile_op — the calibrated slope-timing path),
+aggregates fwd+bwd per op TYPE, and compares the per-op sum against the
+measured end-to-end step time from bench.py.  The per-op sum excludes
+XLA's cross-op fusion, so sum > end-to-end is expected; the per-type
+shares say which op class to attack.
+
+Run on the bench chip:
+    python scripts/inception_bottleneck.py [--layout nhwc] [--top 25]
+"""
+
+import os
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import flexflow_tpu as ff
+from flexflow_tpu.models.inception import build_inception_v3
+from flexflow_tpu.profiling import profile_op
+
+
+def main():
+    layout = "nhwc"
+    top = 25
+    args = sys.argv[1:]
+
+    def _val(i, flag):
+        if i + 1 >= len(args):
+            raise SystemExit(f"usage: missing value for {flag}")
+        return args[i + 1]
+
+    for i, a in enumerate(args):
+        if a == "--layout":
+            layout = _val(i, a)
+        if a == "--top":
+            top = int(_val(i, a))
+
+    from bench import probe_backend
+    probe = probe_backend()
+    if "error" in probe:
+        print(f"backend unavailable: {probe['error']}", flush=True)
+        raise SystemExit(1)
+
+    cfg = ff.FFConfig(batch_size=128, compute_dtype="bfloat16")
+    cfg.conv_layout = layout
+    model, _, _ = build_inception_v3(cfg, num_classes=1000, image_size=299)
+
+    by_type = defaultdict(float)
+    rows = []
+    failed = []
+    for op in model.layers:
+        try:
+            r = profile_op(op, "bfloat16", conv_layout=layout)
+            fwd, bwd = r["fwd_ms"], r["bwd_ms"]
+        except Exception as e:  # tunnel flake/compile error mid-run must
+            # not lose the chip time already spent on earlier ops
+            failed.append(op.name)
+            print(f"{op.name:34s} {op.op_type.value:12s} FAILED "
+                  f"({type(e).__name__})", flush=True)
+            continue
+        if fwd != fwd or bwd != bwd:  # NaN: unprofilable/tunnel flake —
+            # excluding (not zeroing) keeps the attribution honest
+            failed.append(op.name)
+            print(f"{op.name:34s} {op.op_type.value:12s} FAILED (NaN)",
+                  flush=True)
+            continue
+        tot = fwd + bwd
+        by_type[op.op_type.value] += tot
+        rows.append((tot, fwd, bwd, op.name, op.op_type.value))
+        print(f"{op.name:34s} {op.op_type.value:12s} "
+              f"fwd {fwd:7.3f}  bwd {bwd:7.3f}  ms", flush=True)
+
+    total = sum(by_type.values())
+    if not total:
+        raise SystemExit(f"no op profiled successfully ({len(failed)} failed)")
+    if failed:
+        print(f"\nWARNING: {len(failed)} ops failed to profile and are "
+              f"EXCLUDED from the aggregate: {failed}")
+    print(f"\n== per-type aggregate (layout={layout}, b128 bf16) ==")
+    for k, v in sorted(by_type.items(), key=lambda kv: -kv[1]):
+        print(f"{k:14s} {v:8.2f} ms  {100 * v / total:5.1f}%")
+    print(f"{'SUM':14s} {total:8.2f} ms  (end-to-end bench: see bench.py"
+          " row; sum excludes cross-op fusion)")
+
+    print(f"\n== top {top} single ops ==")
+    for tot, fwd, bwd, name, kind in sorted(rows, reverse=True)[:top]:
+        print(f"{tot:8.3f} ms  {name:34s} {kind:12s} "
+              f"(fwd {fwd:.3f} / bwd {bwd:.3f})")
+
+
+if __name__ == "__main__":
+    main()
