@@ -83,6 +83,12 @@ HBM_BW = {
 CONV_LAYOUT = "auto"
 BEST_LAYOUT = {"inception_v3": "nhwc"}
 
+# --flash auto|on|off -> config.flash_attention None/True/False.  The
+# round-3 tuning that set auto's s>=1024 threshold timed FORWARD only;
+# in training the dense path also pays the O(s^2) score matrix in the
+# backward pass, so the crossover for the full step may sit lower.
+FLASH = "auto"
+
 # sweep order: headline first so an interrupted sweep still records it
 SWEEP = ["inception_v3", "alexnet", "resnet50", "nmt", "transformer",
          "dlrm", "candle_uno"]
@@ -100,6 +106,7 @@ def build(model_name: str, batch_size: int):
     cfg = ff.FFConfig(batch_size=batch_size, compute_dtype="bfloat16")
     cfg.conv_layout = (BEST_LAYOUT.get(model_name, "nchw")
                        if CONV_LAYOUT == "auto" else CONV_LAYOUT)
+    cfg.flash_attention = {"auto": None, "on": True, "off": False}[FLASH]
     if model_name == "inception_v3":
         from flexflow_tpu.models.inception import build_inception_v3
         model, inp, logits = build_inception_v3(cfg, num_classes=1000,
@@ -316,7 +323,7 @@ def bench_model(model_name, batch_size, iters):
 
 
 def main():
-    global CONV_LAYOUT
+    global CONV_LAYOUT, FLASH
     model_name = None  # default: full sweep
     batch_size = 0
     iters = 20
@@ -346,6 +353,13 @@ def main():
             sweep = _val(i, a).split(",")
         if a == "--conv-layout":
             CONV_LAYOUT = _val(i, a).lower()
+        if a == "--flash":
+            FLASH = _val(i, a).lower()
+            if FLASH not in ("auto", "on", "off"):
+                print(json.dumps({"metric": "bench_error", "value": None,
+                                  "error": f"--flash must be auto|on|off, "
+                                           f"got {FLASH!r}"}), flush=True)
+                raise SystemExit(2)
     if "--all" in args or model_name == "all":
         model_name = None
 

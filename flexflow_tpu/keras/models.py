@@ -27,15 +27,8 @@ _LOSS_MAP = {
     "mse": core_losses.MEAN_SQUARED_ERROR,
 }
 
-_METRIC_MAP = {
-    "accuracy": core_metrics.ACCURACY,
-    "categorical_crossentropy": core_metrics.CATEGORICAL_CROSSENTROPY,
-    "sparse_categorical_crossentropy":
-        core_metrics.SPARSE_CATEGORICAL_CROSSENTROPY,
-    "mean_squared_error": core_metrics.MEAN_SQUARED_ERROR,
-    "root_mean_squared_error": core_metrics.ROOT_MEAN_SQUARED_ERROR,
-    "mean_absolute_error": core_metrics.MEAN_ABSOLUTE_ERROR,
-}
+# metric spellings (incl. keras aliases) are canonicalized by the core:
+# metrics.canonicalize_metrics — one table, not two
 
 
 class BaseModel:
@@ -117,11 +110,9 @@ class BaseModel:
             assert kwargs.pop(k, None) is None, f"{k} is not supported"
         assert loss is not None, "loss is None"
         loss_type = _LOSS_MAP.get(loss, loss) if isinstance(loss, str) else loss
-        metric_types = []
         for m in metrics or []:
-            assert isinstance(m, str) and m in _METRIC_MAP, \
-                f"unsupported metric {m!r}"
-            metric_types.append(_METRIC_MAP[m])
+            assert isinstance(m, str), f"unsupported metric {m!r}"
+        metric_types = core_metrics.canonicalize_metrics(metrics or [])
         if config is None:
             # pick up the flexflow-tpu runner's parsed flags (cli.py)
             import flexflow_tpu

@@ -23,6 +23,37 @@ MEAN_SQUARED_ERROR = "mean_squared_error"
 ROOT_MEAN_SQUARED_ERROR = "root_mean_squared_error"
 MEAN_ABSOLUTE_ERROR = "mean_absolute_error"
 
+KNOWN_METRICS = (ACCURACY, CATEGORICAL_CROSSENTROPY,
+                 SPARSE_CATEGORICAL_CROSSENTROPY, MEAN_SQUARED_ERROR,
+                 ROOT_MEAN_SQUARED_ERROR, MEAN_ABSOLUTE_ERROR)
+
+# keras-style spellings accepted by FFModel.compile (the reference's enum
+# makes unknown metrics impossible, metrics_functions.h:45-57 — a typo'd
+# string silently measuring nothing is the failure mode to close here)
+_ALIASES = {
+    "acc": ACCURACY,
+    "categorical_accuracy": ACCURACY,
+    "sparse_categorical_accuracy": ACCURACY,
+    "cce": CATEGORICAL_CROSSENTROPY,
+    "scce": SPARSE_CATEGORICAL_CROSSENTROPY,
+    "mse": MEAN_SQUARED_ERROR,
+    "rmse": ROOT_MEAN_SQUARED_ERROR,
+    "mae": MEAN_ABSOLUTE_ERROR,
+}
+
+
+def canonicalize_metrics(names: Sequence[str]) -> List[str]:
+    """Map aliases onto canonical names; reject unknown metrics loudly."""
+    out = []
+    for m in names:
+        c = _ALIASES.get(m, m)
+        if c not in KNOWN_METRICS:
+            raise ValueError(
+                f"unknown metric {m!r}; known: {list(KNOWN_METRICS)} "
+                f"(+ aliases {sorted(_ALIASES)})")
+        out.append(c)
+    return out
+
 
 @dataclasses.dataclass
 class PerfMetrics:

@@ -328,7 +328,8 @@ class FFModel:
             self.loss_type = loss_type
         if self.loss_type is None:
             self.loss_type = losses_mod.SPARSE_CATEGORICAL_CROSSENTROPY
-        self.metrics = list(metrics or self.metrics or [])
+        self.metrics = metrics_mod.canonicalize_metrics(
+            list(metrics or self.metrics or []))
         self.comp_mode = comp_mode
         self._final_tensor = final_tensor or self.layers[-1].outputs[0]
         # Reference-parity fused softmax-CE contract: the reference's loss

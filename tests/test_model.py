@@ -109,6 +109,25 @@ def test_fit_epoch_loop_and_metrics():
     assert 0.0 <= pm.accuracy <= 1.0
 
 
+def test_metric_aliases_and_unknown_rejected():
+    """Keras-style metric spellings canonicalize; a typo fails loudly at
+    compile() instead of silently measuring nothing (the reference's enum
+    makes unknown metrics unrepresentable, metrics_functions.h:45-57)."""
+    import pytest
+
+    model, logits = small_mlp(batch=8)
+    model.compile(ff.SGDOptimizer(lr=0.1),
+                  ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  ["sparse_categorical_accuracy", "mse"],
+                  final_tensor=logits)
+    assert model.metrics == [ff.METRICS_ACCURACY, "mean_squared_error"]
+    model2, logits2 = small_mlp(batch=8)
+    with pytest.raises(ValueError, match="unknown metric 'accuarcy'"):
+        model2.compile(ff.SGDOptimizer(lr=0.1),
+                       ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                       ["accuarcy"], final_tensor=logits2)
+
+
 def test_alexnet_builds_and_steps():
     cfg = ff.FFConfig(batch_size=4, compute_dtype="float32")
     model, inp, logits = build_alexnet(cfg, num_classes=10, image_size=64)
