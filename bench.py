@@ -203,12 +203,17 @@ def _apply_platform():
         jax.config.update("jax_platforms", p)
 
 
-def probe_backend(attempts=None, timeout=None, backoffs=(10, 20, 40)):
+def probe_backend(attempts=None, timeout=None,
+                  backoffs=(30, 60, 180, 420, 780)):
     """Check backend liveness in a subprocess (a down tunnel can HANG
     jax.devices() — only a subprocess + kill detects that).  Returns the
-    probe dict on success; returns an error dict after all attempts."""
+    probe dict on success; returns an error dict after all attempts.
+    The BACKOFF SUM (1470s), not attempts x timeout, sizes the window a
+    fast-raising outage is ridden out: ~25 min either way (observed
+    round 4) — an early structured failure is still an empty
+    scoreboard."""
     import os
-    attempts = attempts or int(os.environ.get("FF_BENCH_PROBE_ATTEMPTS", 4))
+    attempts = attempts or int(os.environ.get("FF_BENCH_PROBE_ATTEMPTS", 6))
     timeout = timeout or float(os.environ.get("FF_BENCH_PROBE_TIMEOUT", 150))
     last = "no attempt made"
     for i in range(attempts):

@@ -185,18 +185,23 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
            overlap_backward_update: bool = False,
            verbose: bool = False, flash_attention=None,
            devices_per_slice: int = 0, remat: bool = False,
-           compute_dtype: str = "bfloat16", conv_layout: str = "auto"
+           compute_dtype: str = "bfloat16", conv_layout: str = "auto",
+           sim: Optional[Simulator] = None
            ) -> Tuple[Dict[str, ParallelConfig], MeshShape, float]:
     """Run the annealing loop; returns (best strategies, best mesh
     factorization, best simulated time).  ``devices_per_slice`` < the
     device count makes the objective slice-aware: weight-sync replica
     groups that cross a slice pay the DCN term (reference
-    simulator.cu:27-29 inter-node fabric)."""
+    simulator.cu:27-29 inter-node fabric).  ``sim`` lets the caller
+    share a Simulator (and, in measure mode, its on-chip measurement
+    cache) with its own baseline evaluations."""
     rng = random.Random(seed)
-    sim = Simulator(spec=spec, num_devices=num_devices, measure=measure,
-                    flash_attention=flash_attention,
-                    devices_per_slice=devices_per_slice, remat=remat,
-                    compute_dtype=compute_dtype, conv_layout=conv_layout)
+    sim = sim or Simulator(
+        spec=spec, num_devices=num_devices, measure=measure,
+        flash_attention=flash_attention,
+        devices_per_slice=devices_per_slice, remat=remat,
+        compute_dtype=compute_dtype, conv_layout=conv_layout)
+    measure = sim.measure
     meshes = candidate_meshes(num_devices)
 
     def dp_mesh() -> MeshShape:

@@ -11,17 +11,29 @@ search cannot at least match DP.
 
 Run on the CPU host (no chip needed — analytic mode):
     python scripts/search_vs_dp.py [--budget 4000] [--out artifacts]
+
+Run on the bench chip with MEASURED per-op times feeding the objective
+(the reference's measure path, simulator.cc:235-273; VERDICT r3 #3
+"measure mode on the chip when back"):
+    python scripts/search_vs_dp.py --measure [--budget 300]
+(--measure keeps the default platform, probes the backend first, and
+uses a smaller budget/config set — each novel op sub-shape in the
+anneal costs an on-chip microbenchmark.)
 """
 
 import os
 import sys
 import time
 
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+MEASURE = "--measure" in sys.argv
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+if not MEASURE:
+    jax.config.update("jax_platforms", "cpu")
 
 import flexflow_tpu as ff  # noqa: E402
 from flexflow_tpu.config import ParallelConfig  # noqa: E402
@@ -71,7 +83,7 @@ def dp_strategies(layers, ndev):
 
 
 def main():
-    budget = 4000
+    budget = 300 if MEASURE else 4000
     out_dir = "artifacts"
     args = sys.argv[1:]
     for i, a in enumerate(args):
@@ -81,17 +93,30 @@ def main():
             out_dir = args[i + 1]
     os.makedirs(out_dir, exist_ok=True)
 
+    configs = CONFIGS
+    if MEASURE:
+        from bench import probe_backend
+        probe = probe_backend()
+        if "error" in probe:
+            print(f"backend unavailable: {probe['error']}", flush=True)
+            raise SystemExit(1)
+        # the chip-measured objective: nmt (the big analytic win — does a
+        # measured objective agree?) + the transformer hybrid point
+        configs = [("nmt", 256, 8), ("transformer", 8, 8)]
+
     rows = []
-    for name, batch, ndev in CONFIGS:
+    for name, batch, ndev in configs:
         model = build(name, batch)
         layers = model.layers
-        sim = Simulator(spec=V5E_SPEC, num_devices=ndev)
+        sim = Simulator(spec=V5E_SPEC, num_devices=ndev, measure=MEASURE)
         dp = dp_strategies(layers, ndev)
         t_dp = sim.simulate(layers, dp)
         t0 = time.perf_counter()
+        # sharing `sim` reuses its measurement cache: the DP sub-shapes
+        # already microbenchmarked for t_dp aren't re-run on chip
         best, best_mesh, t_best = search(
             layers, ndev, budget=budget, seed=0, spec=V5E_SPEC,
-            flash_attention=None)
+            flash_attention=None, sim=sim)
         wall = time.perf_counter() - t0
         speedup = t_dp / t_best
         mesh = {a: s for a, s in best_mesh.items() if s > 1}
@@ -99,22 +124,32 @@ def main():
         n_hybrid = sum(1 for op in layers
                        if tuple(best[op.name].dims) != tuple(
                            dp[op.name].dims))
+        suffix = "_measured" if MEASURE else ""
         pb = os.path.join(out_dir,
-                          f"searched_{name}_b{batch}_{ndev}dev.pb")
+                          f"searched_{name}_b{batch}_{ndev}dev{suffix}.pb")
         save_strategy_file(pb, best)
         rows.append((name, batch, ndev, t_dp * 1e3, t_best * 1e3, speedup,
                      mesh, n_hybrid, len(layers), wall, pb))
         print(f"{name} b{batch} x{ndev}: DP {t_dp * 1e3:.3f} ms -> "
               f"searched {t_best * 1e3:.3f} ms ({speedup:.2f}x), "
               f"mesh {mesh}, {n_hybrid}/{len(layers)} ops non-DP, "
-              f"{wall:.0f}s search wall-clock")
-        assert t_best <= t_dp * 1.001, (name, t_best, t_dp)
+              f"{wall:.0f}s search wall-clock", flush=True)
+        # measured objective carries microbenchmark noise; 5% slack there
+        assert t_best <= t_dp * (1.05 if MEASURE else 1.001), \
+            (name, t_best, t_dp)
 
-    md = os.path.join(out_dir, "SEARCH_VS_DP.md")
+    md = os.path.join(out_dir,
+                      "SEARCH_VS_DP_MEASURED.md" if MEASURE
+                      else "SEARCH_VS_DP.md")
+    mode = ("MEASURE-mode (per-op times microbenchmarked ON-CHIP via "
+            "profiling.profile_op, simulator.cc:235-273 design)"
+            if MEASURE else "Analytic-mode")
     with open(md, "w") as f:
         f.write(
-            "# Searched strategy vs data parallelism (simulated, v5e)"
-            "\n\nAnalytic-mode MCMC (reference model.cc:1020-1054 loop; "
+            "# Searched strategy vs data parallelism "
+            f"({'chip-measured objective' if MEASURE else 'simulated'}, "
+            "v5e)"
+            f"\n\n{mode} MCMC (reference model.cc:1020-1054 loop; "
             f"budget {budget}, seed 0, v5e DeviceSpec, greedy multi-start "
             "over all mesh factorizations).  Simulated per-iteration "
             "times include weight-sync allreduce and producer/consumer "
@@ -134,8 +169,8 @@ def main():
             f.write(f"| {name} | {batch} | {ndev} | {dp_ms:.3f} | "
                     f"{best_ms:.3f} | **{sp:.2f}x** | `{mesh}` | "
                     f"{nh}/{nl} | `{pb}` |\n")
-        f.write("\nReproduce: `python scripts/search_vs_dp.py --budget "
-                f"{budget}`.\n")
+        f.write("\nReproduce: `python scripts/search_vs_dp.py "
+                f"{'--measure ' if MEASURE else ''}--budget {budget}`.\n")
     print(f"wrote {md}")
 
 
