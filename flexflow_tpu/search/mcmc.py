@@ -196,12 +196,22 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
     share a Simulator (and, in measure mode, its on-chip measurement
     cache) with its own baseline evaluations."""
     rng = random.Random(seed)
-    sim = sim or Simulator(
-        spec=spec, num_devices=num_devices, measure=measure,
-        flash_attention=flash_attention,
-        devices_per_slice=devices_per_slice, remat=remat,
-        compute_dtype=compute_dtype, conv_layout=conv_layout)
-    measure = sim.measure
+    if sim is not None:
+        # the shared sim's config IS the objective; contradicting kwargs
+        # would silently split seed-ranking from the acceptance test
+        assert measure == sim.measure or not measure, \
+            f"measure={measure} contradicts shared sim.measure={sim.measure}"
+        measure = sim.measure
+        spec, remat = sim.spec, sim.remat
+        flash_attention = sim.flash_attention
+        devices_per_slice = sim.devices_per_slice
+        compute_dtype, conv_layout = sim.compute_dtype, sim.conv_layout
+    else:
+        sim = Simulator(
+            spec=spec, num_devices=num_devices, measure=measure,
+            flash_attention=flash_attention,
+            devices_per_slice=devices_per_slice, remat=remat,
+            compute_dtype=compute_dtype, conv_layout=conv_layout)
     meshes = candidate_meshes(num_devices)
 
     def dp_mesh() -> MeshShape:
