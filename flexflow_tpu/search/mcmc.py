@@ -201,6 +201,9 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
         # would silently split seed-ranking from the acceptance test
         assert measure == sim.measure or not measure, \
             f"measure={measure} contradicts shared sim.measure={sim.measure}"
+        assert num_devices == sim.num_devices, \
+            (f"num_devices={num_devices} contradicts shared "
+             f"sim.num_devices={sim.num_devices}")
         measure = sim.measure
         spec, remat = sim.spec, sim.remat
         flash_attention = sim.flash_attention

@@ -8,12 +8,8 @@ by the driver (BENCH_r*.json) and the round-4 A/B runs (BASELINE.md).
 """
 
 import json
-import sys
-import os
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-import bench
+import bench  # repo root is on sys.path via tests/conftest.py
 
 
 def _fake_bench(rows):

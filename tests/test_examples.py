@@ -62,6 +62,22 @@ def test_native_example_scripts_run(script):
     _run_example(script, "-b", "32", "-e", "1")
 
 
+def test_pipeline_moe_example_runs():
+    """{n,e,p} composition example (round-4 PipelineSegment showcase) —
+    on a real 8-device mesh, not the single-device fallback."""
+    from tests.subproc import cached_env
+    env = cached_env(XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    script = os.path.join(REPO,
+                          "examples/python/native/pipeline_moe_transformer.py")
+    out = subprocess.run(
+        [sys.executable, "-m", "flexflow_tpu.cli", script, "-b", "8",
+         "-e", "1"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600)
+    assert out.returncode == 0, (out.stdout[-1000:], out.stderr[-2000:])
+    assert "THROUGHPUT" in out.stdout
+    assert "mesh n2 x e2 x p2" in out.stdout
+
+
 @pytest.mark.slow  # full 224x224 AlexNet compile via the torch shim
 def test_alexnet_torch_example_runs():
     _run_example("examples/python/native/alexnet_torch.py", "-b", "32",
