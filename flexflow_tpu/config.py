@@ -115,6 +115,10 @@ class FFConfig:
     cpus_per_node: int = 1      # -ll:cpu
     num_nodes: int = 1          # --nodes
     profiling: bool = False
+    # -p/--print-freq: epochs between metric prints in fit().  The
+    # reference parses printFreq (model.cc:1223-1226) into config.h:85 but
+    # never reads it; here it actually gates the epoch line.
+    print_frequency: int = 1
     # strategy search knobs (reference model.cc:1253-1260)
     search_budget: int = 0      # --budget: MCMC iterations
     search_alpha: float = 0.05  # --alpha: annealing temperature
@@ -179,6 +183,8 @@ class FFConfig:
                 cfg.learning_rate = float(val())
             elif a in ("--wd", "--weight-decay"):
                 cfg.weight_decay = float(val())
+            elif a in ("-p", "--print-freq"):
+                cfg.print_frequency = max(1, int(val()))
             elif a in ("-d", "--dataset"):
                 cfg.dataset_path = val()
             elif a == "--budget":

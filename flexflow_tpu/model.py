@@ -903,9 +903,6 @@ class FFModel:
                     epoch_sums.append(sums)
                 for sums in jax.device_get(epoch_sums):
                     self.perf_metrics.update(sums)
-                if verbose:
-                    print(f"epoch {epoch}: "
-                          f"{self.perf_metrics.report(self.metrics or [self.loss_type])}")
                 # structured per-epoch record (one parseable JSON line; the
                 # reference only had printf metrics — SURVEY §5 observability)
                 from .fflogger import get_logger
@@ -917,8 +914,16 @@ class FFModel:
                        for k, v in self.perf_metrics.scalars().items()})
                 for cb in callbacks:
                     cb.on_epoch_end(epoch, self.perf_metrics)
-                if any(getattr(cb, "stop_training", False)
-                       for cb in callbacks):
+                stopping = any(getattr(cb, "stop_training", False)
+                               for cb in callbacks)
+                # -p/--print-freq gates the human line only (the JSON event
+                # above records every epoch); first/last/stopping epochs
+                # always print
+                if verbose and (epoch % cfg.print_frequency == 0
+                                or epoch == epochs - 1 or stopping):
+                    print(f"epoch {epoch}: "
+                          f"{self.perf_metrics.report(self.metrics or [self.loss_type])}")
+                if stopping:
                     break
             jax.block_until_ready(self._params)
         elapsed = time.time() - t_start

@@ -17,7 +17,9 @@ def test_parse_args_reference_flagset():
     cfg = FFConfig.parse_args([
         "-e", "5", "-b", "128", "--lr", "0.1", "--wd", "0.001",
         "-ll:tpu", "4", "--nodes", "2", "--budget", "100", "--alpha", "0.2",
-        "--profiling", "-s", "out.pb", "-import", "in.pb", "--seed", "7"])
+        "--profiling", "-s", "out.pb", "-import", "in.pb", "--seed", "7",
+        "-p", "3"])
+    assert cfg.print_frequency == 3
     assert cfg.epochs == 5 and cfg.batch_size == 128
     assert cfg.learning_rate == 0.1 and cfg.weight_decay == 0.001
     assert cfg.workers_per_node == 4 and cfg.num_nodes == 2
