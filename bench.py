@@ -1,13 +1,16 @@
 #!/usr/bin/env python
 """Benchmark harness — one JSON line per benched model, then a summary line.
 
-Default (no args) sweeps ALL BASELINE.md configs in one process — inception
-first (the north-star headline, so a mid-sweep kill still records it), then
+Default (no args) sweeps ALL BASELINE.md configs — inception first (the
+north-star headline, so a mid-sweep kill still records it), then
 alexnet / resnet50 / nmt / transformer / dlrm / candle_uno — printing one
 JSON line per model as it completes, and finally a summary line whose
 headline fields (metric/value/unit/vs_baseline) are the Inception numbers
-and whose ``results`` map carries every model's row.  ``--model X`` benches
-a single model and prints a single line (round-2 behavior).
+and whose ``results`` map carries every model's row.  Each model runs in
+a KILLABLE subprocess with its own timeout (``--inproc`` restores the
+single-process loop): the observed mid-sweep failure mode is the tunnel
+dying under a compile, which hangs in C++ beyond any in-process timeout.
+``--model X`` benches a single model in-process and prints one line.
 
 Resilience (VERDICT r3 #1): the backend is probed in a SUBPROCESS with a
 hard timeout before anything imports jax in this process — on this rig a
@@ -41,6 +44,7 @@ publishes no numbers; the north star is ">=1x per-chip A100 samples/sec").
 """
 
 import json
+import os
 import subprocess
 import sys
 import time
@@ -380,9 +384,50 @@ def main():
         print(json.dumps(bench_model(model_name, batch_size, iters)),
               flush=True)
         return
-    summary = run_sweep(sweep, batch_size, iters, budget_s)
+    bench = (None if "--inproc" in args
+             else _subprocess_bench(budget_s))
+    summary = run_sweep(sweep, batch_size, iters, budget_s, _bench=bench)
     if summary["models_ok"] == 0:
         raise SystemExit(1)
+
+
+def _subprocess_bench(budget_s):
+    """Per-model bench in a KILLABLE subprocess.  The probe only proves
+    the backend was alive at sweep start; the observed failure mode
+    (round 4) is the tunnel dying mid-run, which leaves an XLA
+    compile/execute hung in C++ where no in-process timeout can reach
+    it.  One hung model must cost its timeout, not the whole sweep."""
+    def f(name, batch_size, iters):
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--model", name, "--iters", str(iters),
+               "--conv-layout", CONV_LAYOUT, "--flash", FLASH]
+        if batch_size:
+            cmd += ["--batch", str(batch_size)]
+        # floor 300s > the child's worst-case probe (2 x 60s + 30s
+        # backoff); the iters term covers long timed legs (8*iters steps
+        # at a conservative 0.3 s/step) on top of init + compile
+        timeout = min(1200.0, max(300.0, budget_s / 3,
+                                  120 + 8 * iters * 0.3))
+        env = dict(os.environ)
+        # the parent's probe already rode out any outage; the child's
+        # probe should fail fast inside the parent's timeout
+        env.setdefault("FF_BENCH_PROBE_ATTEMPTS", "2")
+        env.setdefault("FF_BENCH_PROBE_TIMEOUT", "60")
+        p = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout, env=env)
+        for line in reversed(p.stdout.splitlines()):
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(row, dict):
+                continue
+            if "error" in row:
+                raise RuntimeError(row["error"])
+            return row
+        raise RuntimeError(
+            f"rc={p.returncode}: {(p.stderr or p.stdout).strip()[-300:]}")
+    return f
 
 
 def run_sweep(sweep, batch_size=0, iters=20, budget_s=1500.0,
