@@ -413,21 +413,38 @@ def _subprocess_bench(budget_s):
         # probe should fail fast inside the parent's timeout
         env.setdefault("FF_BENCH_PROBE_ATTEMPTS", "2")
         env.setdefault("FF_BENCH_PROBE_TIMEOUT", "60")
-        p = subprocess.run(cmd, capture_output=True, text=True,
-                           timeout=timeout, env=env)
-        for line in reversed(p.stdout.splitlines()):
-            try:
-                row = json.loads(line)
-            except ValueError:
-                continue
-            if not isinstance(row, dict):
-                continue
-            if "error" in row:
-                raise RuntimeError(row["error"])
-            return row
-        raise RuntimeError(
-            f"rc={p.returncode}: {(p.stderr or p.stdout).strip()[-300:]}")
+        try:
+            p = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=timeout, env=env)
+        except subprocess.TimeoutExpired as e:
+            # keep the child's partial output: it distinguishes a tunnel
+            # hang (probe logs) from a slow compile (no output yet)
+            def _tail(b):
+                s = b.decode(errors="replace") if isinstance(b, bytes) \
+                    else (b or "")
+                return s.strip()[-300:]
+            raise RuntimeError(
+                f"killed after {timeout:.0f}s; child stdout: "
+                f"{_tail(e.stdout)!r} stderr: {_tail(e.stderr)!r}") from e
+        return _parse_child_row(p.stdout, p.returncode, p.stderr)
     return f
+
+
+def _parse_child_row(stdout, returncode, stderr):
+    """Last JSON DICT line of a child bench's stdout; error rows re-raise
+    (so the sweep records them), non-dict JSON noise is skipped."""
+    for line in reversed(stdout.splitlines()):
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if not isinstance(row, dict):
+            continue
+        if "error" in row:
+            raise RuntimeError(row["error"])
+        return row
+    raise RuntimeError(
+        f"rc={returncode}: {(stderr or stdout).strip()[-300:]}")
 
 
 def run_sweep(sweep, batch_size=0, iters=20, budget_s=1500.0,

@@ -1,0 +1,40 @@
+"""Keras callbacks demo — LearningRateScheduler + EpochVerifyMetrics on a
+small CIFAR-10 CNN (reference examples/python/keras/callback.py)."""
+
+import numpy as np
+
+from flexflow_tpu import get_default_config
+from flexflow_tpu.keras import (Activation, Conv2D, Dense, EpochVerifyMetrics,
+                                Flatten, Input, LearningRateScheduler,
+                                MaxPooling2D, Model, ModelAccuracy, SGD)
+from flexflow_tpu.keras.datasets import cifar10
+
+
+def lr_scheduler(epoch):
+    return 0.01 if epoch == 0 else 0.02
+
+
+def top_level_task():
+    cfg = get_default_config()
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train.astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+
+    inp = Input((3, 32, 32))
+    t = Conv2D(32, kernel_size=(3, 3), strides=(1, 1), padding=(1, 1),
+               activation="relu")(inp)
+    t = MaxPooling2D(pool_size=(2, 2), strides=(2, 2))(t)
+    t = Flatten()(t)
+    t = Dense(128, activation="relu")(t)
+    out = Activation("softmax")(Dense(10)(t))
+    model = Model(inp, out)
+    model.compile(SGD(learning_rate=0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], config=cfg)
+    model.fit(x_train, y_train, epochs=cfg.epochs,
+              callbacks=[LearningRateScheduler(lr_scheduler),
+                         EpochVerifyMetrics(ModelAccuracy.CIFAR10_CNN)])
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,51 @@
+"""Net2Net-style weight transfer: train a teacher MLP, seed a student
+model with the teacher's trained weights via layer get/set_weights, then
+continue training (reference examples/python/keras/func_mnist_mlp_net2net.py
+teacher/student flow)."""
+
+import numpy as np
+
+from flexflow_tpu import get_default_config
+from flexflow_tpu.keras import (Activation, Dense, Input, Model,
+                                ModelAccuracy, SGD, VerifyMetrics)
+from flexflow_tpu.keras.datasets import mnist
+
+
+def top_level_task():
+    cfg = get_default_config()
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 784).astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+
+    # teacher
+    inp = Input((784,))
+    d1 = Dense(256, activation="relu")
+    d2 = Dense(128, activation="relu")
+    d3 = Dense(10)
+    out = Activation("softmax")(d3(d2(d1(inp))))
+    teacher = Model(inp, out)
+    teacher.compile(SGD(learning_rate=0.01),
+                    loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy"], config=cfg)
+    teacher.fit(x_train, y_train, epochs=cfg.epochs)
+    w1, w2, w3 = (d.get_weights(teacher.ffmodel) for d in (d1, d2, d3))
+
+    # student: same topology, seeded from the teacher (net2net identity
+    # transfer), then fine-tuned
+    s_inp = Input((784,))
+    s1 = Dense(256, activation="relu")
+    s2 = Dense(128, activation="relu")
+    s3 = Dense(10)
+    s_out = Activation("softmax")(s3(s2(s1(s_inp))))
+    student = Model(s_inp, s_out)
+    student.compile(SGD(learning_rate=0.01),
+                    loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy"], config=cfg)
+    for layer, w in ((s1, w1), (s2, w2), (s3, w3)):
+        layer.set_weights(w, student.ffmodel)
+    student.fit(x_train, y_train, epochs=cfg.epochs,
+                callbacks=[VerifyMetrics(ModelAccuracy.MNIST_MLP)])
+
+
+if __name__ == "__main__":
+    top_level_task()

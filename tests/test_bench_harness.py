@@ -52,6 +52,36 @@ def test_sweep_time_budget_skips_not_fails():
     assert "skipped" in summary["results"]["alexnet"]
 
 
+def test_child_row_parse():
+    import pytest
+
+    good = ('WARNING: something\n{"metric": "m", "value": 5.0}\n'
+            'null\n3.14\n')  # trailing JSON noise must be skipped
+    row = bench._parse_child_row(good, 0, "")
+    assert row == {"metric": "m", "value": 5.0}
+    with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+        bench._parse_child_row('{"error": "UNAVAILABLE: tunnel down"}\n',
+                               1, "")
+    with pytest.raises(RuntimeError, match="rc=3"):
+        bench._parse_child_row("no json here\n", 3, "boom traceback")
+
+
+def test_subprocess_bench_timeout_carries_child_output(monkeypatch):
+    import subprocess as sp
+
+    def fake_run(cmd, **kw):
+        raise sp.TimeoutExpired(cmd, kw["timeout"], output=b"probe 1 fail",
+                                stderr=b"hang in compile")
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    f = bench._subprocess_bench(budget_s=300.0)
+    try:
+        f("alexnet", 0, 20)
+        assert False, "expected RuntimeError"
+    except RuntimeError as e:
+        msg = str(e)
+        assert "probe 1 fail" in msg and "hang in compile" in msg
+
+
 def test_probe_failure_is_structured_not_hang():
     # a 1ms timeout kills the probe subprocess before jax can import:
     # exactly the down-tunnel hang path, compressed
