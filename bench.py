@@ -422,7 +422,8 @@ def _subprocess_bench(budget_s):
             def _tail(b):
                 s = b.decode(errors="replace") if isinstance(b, bytes) \
                     else (b or "")
-                return s.strip()[-300:]
+                return s.strip()[-140:]  # both tails must survive
+                # run_sweep's 400-char error-row cap
             raise RuntimeError(
                 f"killed after {timeout:.0f}s; child stdout: "
                 f"{_tail(e.stdout)!r} stderr: {_tail(e.stderr)!r}") from e

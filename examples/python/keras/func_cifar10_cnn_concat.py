@@ -1,0 +1,41 @@
+"""Functional CIFAR-10 CNN with concatenated conv towers (reference
+examples/python/keras/func_cifar10_cnn_concat.py)."""
+
+import numpy as np
+
+from flexflow_tpu import get_default_config
+from flexflow_tpu.keras import (Activation, Concatenate, Conv2D, Dense,
+                                Flatten, Input, MaxPooling2D, Model,
+                                ModelAccuracy, SGD, VerifyMetrics)
+from flexflow_tpu.keras.datasets import cifar10
+
+
+def top_level_task():
+    cfg = get_default_config()
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train.astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+
+    inp = Input((3, 32, 32))
+    t1 = Conv2D(32, (3, 3), strides=(1, 1), padding=(1, 1),
+                activation="relu")(inp)
+    t2 = Conv2D(32, (3, 3), strides=(1, 1), padding=(1, 1),
+                activation="relu")(inp)
+    t = Concatenate(axis=1)([t1, t2])  # channel-wise tower merge
+    t = MaxPooling2D((2, 2), strides=(2, 2))(t)
+    t = Conv2D(64, (3, 3), strides=(1, 1), padding=(1, 1),
+               activation="relu")(t)
+    t = MaxPooling2D((2, 2), strides=(2, 2))(t)
+    t = Flatten()(t)
+    t = Dense(256, activation="relu")(t)
+    out = Activation("softmax")(Dense(10)(t))
+    model = Model(inp, out)
+    model.compile(SGD(learning_rate=0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], config=cfg)
+    model.fit(x_train, y_train, epochs=cfg.epochs,
+              callbacks=[VerifyMetrics(ModelAccuracy.CIFAR10_CNN)])
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,34 @@
+"""Functional MNIST CNN (reference examples/python/keras/func_mnist_cnn.py)."""
+
+import numpy as np
+
+from flexflow_tpu import get_default_config
+from flexflow_tpu.keras import (Activation, Conv2D, Dense, Flatten, Input,
+                                MaxPooling2D, Model, ModelAccuracy, SGD,
+                                VerifyMetrics)
+from flexflow_tpu.keras.datasets import mnist
+
+
+def top_level_task():
+    cfg = get_default_config()
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 1, 28, 28).astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+
+    inp = Input((1, 28, 28))
+    t = Conv2D(32, (3, 3), padding="valid", activation="relu")(inp)
+    t = Conv2D(64, (3, 3), padding="valid", activation="relu")(t)
+    t = MaxPooling2D((2, 2))(t)
+    t = Flatten()(t)
+    t = Dense(128, activation="relu")(t)
+    out = Activation("softmax")(Dense(10)(t))
+    model = Model(inp, out)
+    model.compile(SGD(learning_rate=0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], config=cfg)
+    model.fit(x_train, y_train, epochs=cfg.epochs,
+              callbacks=[VerifyMetrics(ModelAccuracy.MNIST_CNN)])
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,45 @@
+"""Sequential CIFAR-10 CNN (reference
+examples/python/keras/seq_cifar10_cnn.py)."""
+
+import numpy as np
+
+from flexflow_tpu import get_default_config
+from flexflow_tpu.keras import (Activation, Conv2D, Dense, Flatten,
+                                MaxPooling2D, ModelAccuracy, SGD, Sequential,
+                                VerifyMetrics)
+from flexflow_tpu.keras.datasets import cifar10
+
+
+def top_level_task():
+    cfg = get_default_config()
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train.astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+
+    model = Sequential([
+        Conv2D(32, (3, 3), strides=(1, 1), padding=(1, 1),
+               activation="relu", input_shape=(3, 32, 32)),
+        Conv2D(32, (3, 3), strides=(1, 1), padding=(1, 1),
+               activation="relu"),
+        MaxPooling2D((2, 2), strides=(2, 2)),
+        Conv2D(64, (3, 3), strides=(1, 1), padding=(1, 1),
+               activation="relu"),
+        Conv2D(64, (3, 3), strides=(1, 1), padding=(1, 1),
+               activation="relu"),
+        MaxPooling2D((2, 2), strides=(2, 2)),
+        Flatten(),
+        Dense(512, activation="relu"),
+        Dense(10),
+        Activation("softmax"),
+    ])
+    # lr 0.05: the 4-conv stack needs it to clear the accuracy bound in
+    # the CI epoch budget (reference runs 40+ epochs on real cifar10)
+    model.compile(SGD(learning_rate=0.05),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], config=cfg)
+    model.fit(x_train, y_train, epochs=cfg.epochs,
+              callbacks=[VerifyMetrics(ModelAccuracy.CIFAR10_CNN)])
+
+
+if __name__ == "__main__":
+    top_level_task()

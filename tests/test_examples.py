@@ -91,6 +91,7 @@ def test_alexnet_torch_example_runs():
     "examples/python/keras/seq_reuters_mlp.py",
     "examples/python/keras/candle_uno_keras.py",
     "examples/python/keras/func_mnist_mlp_net2net.py",
+    "examples/python/keras/func_mnist_mlp.py",
 ])
 def test_keras_example_scripts_run(script):
     _run_example(script, "-b", "64", "-e", "2")
@@ -107,6 +108,9 @@ def test_keras_example_scripts_run(script):
     "examples/python/keras/func_cifar10_cnn_nested.py",
     "examples/python/keras/func_cifar10_alexnet.py",
     "examples/python/keras/callback.py",
+    "examples/python/keras/func_mnist_cnn.py",
+    "examples/python/keras/seq_cifar10_cnn.py",
+    "examples/python/keras/func_cifar10_cnn_concat.py",
 ])
 def test_cnn_example_scripts_run(script):
     _run_example(script, "-b", "64", "-e", "4")
