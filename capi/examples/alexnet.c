@@ -1,0 +1,124 @@
+/* AlexNet as a pure-C app over the flat C API — the analogue of the
+ * reference's flagship C++ harness (examples/cpp/AlexNet/alexnet.cc:34-131):
+ * build the conv stack, compile, train on synthetic data with the timing
+ * fence OUTSIDE the loop, and print the reference's ELAPSED/THROUGHPUT
+ * line.  Build: make -C capi examples  Run: capi/examples/alexnet [-e N]
+ */
+
+#include <math.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+
+#include "../flexflow_tpu_c.h"
+
+static double now_s(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + 1e-9 * ts.tv_nsec;
+}
+
+int main(int argc, char** argv) {
+  if (flexflow_init() != 0) {
+    fprintf(stderr, "init failed: %s\n", flexflow_last_error());
+    return 1;
+  }
+  flexflow_config_t cfg = flexflow_config_create(argc - 1, argv + 1);
+  if (!cfg) {
+    fprintf(stderr, "config failed: %s\n", flexflow_last_error());
+    return 1;
+  }
+  int batch = flexflow_config_get_batch_size(cfg);
+  int epochs = flexflow_config_get_epochs(cfg);
+  flexflow_model_t model = flexflow_model_create(cfg);
+
+  /* reference alexnet.cc:41-66 stack (227x227 input variant at 64px for a
+   * CPU-friendly smoke; pass -b to scale) */
+  int img = 64;
+  int64_t dims[] = {batch, 3, img, img};
+  flexflow_tensor_t x =
+      flexflow_model_create_tensor(model, 4, dims, FF_DT_FLOAT, "input");
+/* a NULL tensor would segfault the next adder (handles are deref'd
+ * unchecked in the C layer), so every layer is checked */
+#define CK(t, what)                                                     \
+  if (!(t)) {                                                           \
+    fprintf(stderr, what " failed: %s\n", flexflow_last_error());       \
+    return 1;                                                           \
+  }
+  flexflow_tensor_t t =
+      flexflow_model_conv2d(model, x, 64, 11, 11, 4, 4, 2, 2, FF_AC_RELU, 1,
+                            "conv1");
+  CK(t, "conv1");
+  t = flexflow_model_pool2d(model, t, 3, 3, 2, 2, 0, 0, 1, "pool1");
+  CK(t, "pool1");
+  t = flexflow_model_conv2d(model, t, 192, 5, 5, 1, 1, 2, 2, FF_AC_RELU, 1,
+                            "conv2");
+  CK(t, "conv2");
+  t = flexflow_model_pool2d(model, t, 3, 3, 2, 2, 0, 0, 1, "pool2");
+  CK(t, "pool2");
+  t = flexflow_model_conv2d(model, t, 384, 3, 3, 1, 1, 1, 1, FF_AC_RELU, 1,
+                            "conv3");
+  CK(t, "conv3");
+  t = flexflow_model_conv2d(model, t, 256, 3, 3, 1, 1, 1, 1, FF_AC_RELU, 1,
+                            "conv4");
+  CK(t, "conv4");
+  t = flexflow_model_conv2d(model, t, 256, 3, 3, 1, 1, 1, 1, FF_AC_RELU, 1,
+                            "conv5");
+  CK(t, "conv5");
+  t = flexflow_model_pool2d(model, t, 3, 3, 2, 2, 0, 0, 1, "pool3");
+  CK(t, "pool3");
+  t = flexflow_model_flat(model, t, "flat");
+  CK(t, "flat");
+  t = flexflow_model_dense(model, t, 4096, FF_AC_RELU, 1, "fc6");
+  CK(t, "fc6");
+  t = flexflow_model_dense(model, t, 4096, FF_AC_RELU, 1, "fc7");
+  CK(t, "fc7");
+  flexflow_tensor_t logits =
+      flexflow_model_dense(model, t, 10, FF_AC_NONE, 1, "fc8");
+  CK(logits, "fc8");
+  flexflow_tensor_t probs = flexflow_model_softmax(model, logits, "softmax");
+  CK(probs, "softmax");
+  if (flexflow_model_compile(model, FF_OPT_SGD, 0.01, FF_LOSS_SPARSE_CCE,
+                             probs) != 0 ||
+      flexflow_model_init_layers(model, 0) != 0) {
+    fprintf(stderr, "compile failed: %s\n", flexflow_last_error());
+    return 1;
+  }
+
+  /* synthetic data, staged once (reference alexnet.cc:80-88 random init) */
+  int n = batch * 3 * img * img;
+  float* xb = (float*)malloc(sizeof(float) * n);
+  int32_t* yb = (int32_t*)malloc(sizeof(int32_t) * batch);
+  srand(0);
+  for (int i = 0; i < n; i++) xb[i] = (float)rand() / RAND_MAX;
+  for (int i = 0; i < batch; i++) yb[i] = rand() % 10;
+  const void* inputs[] = {xb};
+
+  /* warm up (compile), then the fenced timing region
+   * (alexnet.cc:90-95,120-126) */
+  double loss = flexflow_model_train_batch(model, 1, inputs, yb);
+  if (isnan(loss)) {  /* header contract: NaN means the step failed */
+    fprintf(stderr, "train failed: %s\n", flexflow_last_error());
+    return 1;
+  }
+  int iters = 4 * epochs;
+  double t0 = now_s();
+  for (int it = 0; it < iters; it++)
+    loss = flexflow_model_train_batch(model, 1, inputs, yb);
+  double dt = now_s() - t0;
+  if (isnan(loss)) {
+    fprintf(stderr, "train failed: %s\n", flexflow_last_error());
+    return 1;
+  }
+  printf("final loss %.4f\n", loss);
+  printf("ELAPSED TIME = %.4fs, THROUGHPUT = %.2f samples/s\n", dt,
+         (double)batch * iters / dt);
+  free(xb);
+  free(yb);
+  flexflow_model_destroy(model);
+  flexflow_config_destroy(cfg);
+  flexflow_finalize();
+  return 0;
+}

@@ -14,6 +14,19 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CAPI = os.path.join(REPO, "capi")
 
 
+def capi_env():
+    """Env for the embedded-CPython binaries: cached_env (CPU platform +
+    shared compile cache; FLEXFLOW_PLATFORM forces the backend via
+    jax.config since a pre-registered PJRT plugin can override
+    JAX_PLATFORMS, and keeps the test off a TPU another process may
+    hold) + a PYTHONPATH the embedded interpreter can import from."""
+    from tests.subproc import cached_env
+    env = cached_env()
+    paths = [REPO] + site.getsitepackages()
+    env["PYTHONPATH"] = ":".join(paths + [env.get("PYTHONPATH", "")])
+    return env
+
+
 @pytest.mark.skipif(shutil.which("g++") is None or
                     shutil.which("python3-config") is None,
                     reason="no native toolchain")
@@ -21,14 +34,26 @@ def test_capi_builds_and_trains():
     r = subprocess.run(["make", "-C", CAPI], capture_output=True, text=True,
                        timeout=300)
     assert r.returncode == 0, r.stderr[-2000:]
-    from tests.subproc import cached_env
-    # FLEXFLOW_PLATFORM forces the backend via jax.config inside the
-    # embedded runtime (a pre-registered PJRT plugin can override
-    # JAX_PLATFORMS) and keeps the test off a TPU another process may hold
-    env = cached_env()
-    paths = [REPO] + site.getsitepackages()
-    env["PYTHONPATH"] = ":".join(paths + [env.get("PYTHONPATH", "")])
+    env = capi_env()
     out = subprocess.run([os.path.join(CAPI, "test_capi")], cwd=CAPI,
                          capture_output=True, text=True, env=env, timeout=300)
     assert out.returncode == 0, (out.stdout[-1000:], out.stderr[-2000:])
     assert "C API OK" in out.stdout
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(shutil.which("g++") is None or
+                    shutil.which("python3-config") is None,
+                    reason="no native toolchain")
+def test_capi_alexnet_example():
+    """The pure-C AlexNet app (reference examples/cpp/AlexNet harness
+    analogue): build graph, train, print the fenced throughput line."""
+    r = subprocess.run(["make", "-C", CAPI, "examples"], capture_output=True,
+                       text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    env = capi_env()
+    out = subprocess.run(
+        [os.path.join(CAPI, "examples", "alexnet"), "-b", "8", "-e", "1"],
+        cwd=CAPI, capture_output=True, text=True, env=env, timeout=600)
+    assert out.returncode == 0, (out.stdout[-1000:], out.stderr[-2000:])
+    assert "THROUGHPUT" in out.stdout
