@@ -1,0 +1,49 @@
+"""Model-as-layer composition: ONE conv-tower Model called on two inputs,
+outputs concatenated (reference
+examples/python/keras/func_cifar10_cnn_concat_model.py /
+func_cifar10_cnn_concat_seq_model.py).  Both call sites share the tower's
+weights."""
+
+import numpy as np
+
+from flexflow_tpu import get_default_config
+from flexflow_tpu.keras import (Activation, Concatenate, Conv2D, Dense,
+                                Flatten, Input, MaxPooling2D, Model,
+                                ModelAccuracy, SGD, Sequential,
+                                VerifyMetrics)
+from flexflow_tpu.keras.datasets import cifar10
+
+
+def build_tower():
+    inp = Input((3, 32, 32))
+    t = Conv2D(32, (3, 3), strides=(1, 1), padding=(1, 1),
+               activation="relu")(inp)
+    t = MaxPooling2D((2, 2), strides=(2, 2))(t)
+    return Model(inp, t)
+
+
+def top_level_task():
+    cfg = get_default_config()
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train.astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+
+    tower = build_tower()                      # functional Model
+    head = Sequential([Flatten(),              # Sequential-as-layer too
+                       Dense(256, activation="relu")])
+
+    a = Input((3, 32, 32))
+    b = Input((3, 32, 32))
+    t = Concatenate(axis=1)([tower(a), tower(b)])  # shared tower weights
+    t = head(t)
+    out = Activation("softmax")(Dense(10)(t))
+    model = Model([a, b], out)
+    model.compile(SGD(learning_rate=0.02),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], config=cfg)
+    model.fit([x_train, x_train], y_train, epochs=cfg.epochs,
+              callbacks=[VerifyMetrics(ModelAccuracy.CIFAR10_CNN)])
+
+
+if __name__ == "__main__":
+    top_level_task()

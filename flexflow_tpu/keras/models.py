@@ -182,6 +182,35 @@ class Model(BaseModel):
     def _layer_list(self):
         return self._topo_layers(self._outputs)
 
+    def __call__(self, inputs):
+        """Model-as-layer (reference func_cifar10_cnn_concat_model.py):
+        replay this model's layer graph on new tensors.  The SAME layer
+        objects are re-invoked, so every call site shares one weight set
+        (the emitted-layer aliasing in ``_build_ff``)."""
+        ins = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+        assert len(ins) == len(self._inputs), \
+            f"model expects {len(self._inputs)} inputs, got {len(ins)}"
+        for t in ins:  # eager model(x) on arrays is not supported — the
+            # deferred graph needs symbolic tensors (use predict())
+            if not isinstance(t, KerasTensor):
+                raise TypeError(
+                    f"model-as-layer expects KerasTensor inputs, got "
+                    f"{type(t).__name__}; use model.predict(x) for arrays")
+        mapping = {id(kt): t for kt, t in zip(self._inputs, ins)}
+        for kt in self._topo_tensors(self._outputs):
+            if id(kt) in mapping:
+                continue
+            layer = kt.producer
+            if layer is None or isinstance(layer, InputLayer):
+                raise ValueError(
+                    "model references an Input() not listed in its inputs")
+            assert kt.index == 0, "multi-output layers can't be replayed"
+            new_in = [mapping[id(t)] for t in kt.inbound]
+            mapping[id(kt)] = layer(
+                new_in if len(new_in) > 1 else new_in[0])
+        outs = [mapping[id(t)] for t in self._outputs]
+        return outs if len(outs) > 1 else outs[0]
+
 
 class Sequential(BaseModel):
     def __init__(self, layers: Optional[Sequence[Layer]] = None, name=None):
@@ -219,3 +248,19 @@ class Sequential(BaseModel):
 
     def _layer_list(self):
         return list(self._stack)
+
+    def __call__(self, t):
+        """Sequential-as-layer (reference
+        func_cifar10_cnn_concat_seq_model.py): apply the stack to a new
+        tensor; weights are shared across call sites."""
+        if not isinstance(t, KerasTensor):
+            raise TypeError(
+                f"model-as-layer expects a KerasTensor input, got "
+                f"{type(t).__name__}; use model.predict(x) for arrays")
+        for layer in self._stack:
+            if isinstance(layer, KerasTensor):
+                layer = layer.producer
+            if isinstance(layer, InputLayer):
+                continue
+            t = layer(t)
+        return t

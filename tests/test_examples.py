@@ -111,6 +111,7 @@ def test_keras_example_scripts_run(script):
     "examples/python/keras/func_mnist_cnn.py",
     "examples/python/keras/seq_cifar10_cnn.py",
     "examples/python/keras/func_cifar10_cnn_concat.py",
+    "examples/python/keras/func_cifar10_cnn_concat_model.py",
 ])
 def test_cnn_example_scripts_run(script):
     _run_example(script, "-b", "64", "-e", "4")

@@ -266,3 +266,35 @@ def test_keras_shared_layer_reuse():
     loss = float(core.train_batch(x, x2, y))
     assert np.isfinite(loss)
     assert np.abs(core.get_weights("shared_fc/kernel") - before).max() > 0
+
+
+def test_model_as_layer_shares_weights():
+    """Model-as-layer (reference func_cifar10_cnn_concat_model.py): a
+    functional Model called on two new inputs replays its graph with ONE
+    shared weight set; a Sequential applies the same way."""
+    import numpy as np
+
+    import flexflow_tpu as ff
+    from flexflow_tpu.keras import Dense, Input, Model, Sequential, Subtract
+    from flexflow_tpu.keras.optimizers import SGD
+
+    inner_in = Input((16,))
+    inner_out = Dense(8, use_bias=False, name="tower_fc")(inner_in)
+    tower = Model(inner_in, inner_out)
+    head = Sequential([Dense(4, use_bias=False, name="head_fc")])
+
+    a = Input((16,))
+    b = Input((16,))
+    out = Subtract()([head(tower(a)), head(tower(b))])
+    cfg = ff.FFConfig(batch_size=8, compute_dtype="float32")
+    model = Model([a, b], out)
+    model.compile(SGD(learning_rate=0.05), loss="mean_squared_error",
+                  config=cfg)
+    core = model.ffmodel
+    kernels = [p for p in core.parameters if p.name.endswith("kernel")]
+    assert len(kernels) == 2, [p.name for p in core.parameters]  # tower+head
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 16)).astype(np.float32)
+    # identical inputs through both shared branches -> exact zero
+    pred = core.predict([x, x], batch_size=8)
+    np.testing.assert_allclose(pred, np.zeros_like(pred), atol=1e-6)
