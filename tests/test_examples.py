@@ -40,13 +40,13 @@ def test_candle_uno_trains():
     assert losses[-1] < losses[0]
 
 
-def _run_example(script, *extra):
+def _run_example(script, *extra, env=None, timeout=600):
     from tests.subproc import cached_env
-    env = cached_env()
+    env = cached_env(**(env or {}))
     out = subprocess.run(
         [sys.executable, "-m", "flexflow_tpu.cli", os.path.join(REPO, script),
          *extra],
-        capture_output=True, text=True, env=env, cwd=REPO, timeout=600)
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=timeout)
     assert out.returncode == 0, (out.stdout[-1000:], out.stderr[-2000:])
     return out
 
@@ -65,17 +65,24 @@ def test_native_example_scripts_run(script):
 def test_pipeline_moe_example_runs():
     """{n,e,p} composition example (round-4 PipelineSegment showcase) —
     on a real 8-device mesh, not the single-device fallback."""
-    from tests.subproc import cached_env
-    env = cached_env(XLA_FLAGS="--xla_force_host_platform_device_count=8")
-    script = os.path.join(REPO,
-                          "examples/python/native/pipeline_moe_transformer.py")
-    out = subprocess.run(
-        [sys.executable, "-m", "flexflow_tpu.cli", script, "-b", "8",
-         "-e", "1"],
-        capture_output=True, text=True, env=env, cwd=REPO, timeout=600)
-    assert out.returncode == 0, (out.stdout[-1000:], out.stderr[-2000:])
+    out = _run_example(
+        "examples/python/native/pipeline_moe_transformer.py", "-b", "8",
+        "-e", "1",
+        env={"XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
     assert "THROUGHPUT" in out.stdout
     assert "mesh n2 x e2 x p2" in out.stdout
+
+
+@pytest.mark.slow  # seq 2048 x 8-device ring compile
+def test_longcontext_app_runs_ring_attention():
+    """The long-context app must actually run 8-way sequence-parallel
+    ring attention, not a single-device fallback."""
+    out = _run_example(
+        "examples/apps/longcontext.py", "-b", "4", "-e", "1",
+        "-ll:tpu", "8", timeout=900,
+        env={"XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
+    assert "ring attention over s=8" in out.stdout
+    assert "THROUGHPUT" in out.stdout
 
 
 @pytest.mark.slow  # full 224x224 AlexNet compile via the torch shim
