@@ -16,10 +16,13 @@ Resilience (VERDICT r3 #1): the backend is probed in a SUBPROCESS with a
 hard timeout before anything imports jax in this process — on this rig a
 down TPU tunnel makes ``jax.devices()`` either raise UNAVAILABLE or hang
 forever, and a hang in the main process would leave the driver with an
-empty scoreboard.  The probe retries with backoff; on persistent failure we
-print a structured ``{"error": ...}`` JSON line and exit nonzero.  Each
-model in the sweep is individually try/except'd so one OOM/compile failure
-cannot empty the round's record.
+empty scoreboard.  The probe retries with backoff, prints a structured
+``bench_error`` JSON line to stdout after EVERY failed attempt (so the last
+stdout line parses even if the driver kills us mid-probe), keeps its total
+wall-clock under ``FF_BENCH_MAX_WAIT`` seconds (default 2400), and on
+persistent failure prints a final ``{"error": ...}`` line and exits
+nonzero.  Each model in the sweep is individually try/except'd so one
+OOM/compile failure cannot empty the round's record.
 
 Measurement methodology matches the reference's fenced timing region
 (examples/cpp/AlexNet/alexnet.cc:90-95, 121-126): warm up, then time N
@@ -207,37 +210,96 @@ def _apply_platform():
         jax.config.update("jax_platforms", p)
 
 
+def _error_line(msg, **extra):
+    """The one bench_error stdout shape (driver contract: last line of
+    stdout always parses with the summary's headline keys present).
+    Truncation keeps head AND tail — the tail of a stderr capture is the
+    exception line that actually names the failure."""
+    if len(msg) > 500:
+        msg = msg[:250] + " ... " + msg[-245:]
+    print(json.dumps({"metric": "bench_error", "value": None,
+                      "unit": "samples/s/chip", "vs_baseline": None,
+                      "error": msg, **extra}), flush=True)
+
+
 def probe_backend(attempts=None, timeout=None,
-                  backoffs=(30, 60, 180, 420, 780)):
+                  backoffs=(30, 60, 180, 420, 780), max_wait=None,
+                  emit_stdout=False):
     """Check backend liveness in a subprocess (a down tunnel can HANG
     jax.devices() — only a subprocess + kill detects that).  Returns the
     probe dict on success; returns an error dict after all attempts.
     The BACKOFF SUM (1470s), not attempts x timeout, sizes the window a
     fast-raising outage is ridden out: ~25 min either way (observed
-    round 4) — an early structured failure is still an empty
-    scoreboard."""
+    round 4) — an early structured failure is still an empty scoreboard.
+
+    Two guarantees for the driver's clock (VERDICT r4 #1 — round 4's
+    rc=124 left ``parsed: null`` because every probe log went to stderr):
+    with ``emit_stdout=True`` (the driver-facing sweep mode) a structured
+    ``bench_error`` JSON line goes to STDOUT after EVERY failed attempt,
+    so stdout's last line parses even if we are killed mid-probe; and
+    total probe wall-clock (attempt timeouts + backoffs) is capped by
+    ``FF_BENCH_MAX_WAIT`` (seconds) so the operator can size the outage
+    armor under the driver's own timeout.  ``emit_stdout`` stays False
+    for child benches (``--model``) and the scripts/ reusers — an interim
+    probe line in a child's stdout would let ``_parse_child_row``
+    misattribute a later crash to a transient probe blip."""
     import os
     attempts = attempts or int(os.environ.get("FF_BENCH_PROBE_ATTEMPTS", 6))
     timeout = timeout or float(os.environ.get("FF_BENCH_PROBE_TIMEOUT", 150))
+    if max_wait is None:
+        max_wait = float(os.environ.get("FF_BENCH_MAX_WAIT", 2400))
+    t0 = time.monotonic()
     last = "no attempt made"
+
+    def _exhausted(n):
+        return {"error": f"backend unavailable: probe window "
+                         f"FF_BENCH_MAX_WAIT={max_wait:.6g}s exhausted "
+                         f"after {n}/{attempts} attempts: {last}",
+                "attempts": n}
+
+    # an attempt shorter than this can't even import jax — launching one
+    # would misreport window exhaustion as a backend hang
+    min_attempt = min(timeout, 30.0)
     for i in range(attempts):
         if i:
-            time.sleep(backoffs[min(i - 1, len(backoffs) - 1)])
+            back = backoffs[min(i - 1, len(backoffs) - 1)]
+            if time.monotonic() - t0 + back + min_attempt > max_wait:
+                return _exhausted(i)
+            time.sleep(back)
+        remaining = max_wait - (time.monotonic() - t0)
+        if remaining < min_attempt:
+            return _exhausted(i)
+        att_timeout = min(timeout, remaining)
         try:
             p = subprocess.run([sys.executable, "-c", _PROBE_SRC],
                                capture_output=True, text=True,
-                               timeout=timeout)
+                               timeout=att_timeout)
             for line in p.stdout.splitlines():
                 if line.startswith("FFPROBE "):
-                    return json.loads(line[len("FFPROBE "):])
+                    info = json.loads(line[len("FFPROBE "):])
+                    if emit_stdout:
+                        # stdout gets a parseable line BEFORE the first
+                        # (long, silent) bench leg: a driver kill during
+                        # that leg must parse as "backend was up", not as
+                        # a stale probe error (i>0) or null (i==0)
+                        print(json.dumps({"metric": "bench_probe",
+                                          "value": info.get("n"),
+                                          "unit": "devices",
+                                          "vs_baseline": None,
+                                          "recovered_after": i}),
+                              flush=True)
+                    return info
             last = (f"rc={p.returncode}: "
                     + (p.stderr.strip() or p.stdout.strip())[-500:])
         except subprocess.TimeoutExpired:
-            last = f"backend init hang (>{timeout}s, killed)"
+            last = f"backend init hang (>{att_timeout:.4g}s, killed)"
         except Exception as e:  # noqa: BLE001
             last = repr(e)
         print(f"# probe attempt {i + 1}/{attempts} failed: {last}",
               file=sys.stderr, flush=True)
+        if emit_stdout:
+            _error_line(f"probe attempt {i + 1}/{attempts} failed: {last}",
+                        probe_attempt=i + 1)
     return {"error": f"backend unavailable after {attempts} attempts: "
                      f"{last}", "attempts": attempts}
 
@@ -343,9 +405,7 @@ def main():
     def _val(i, flag):
         if i + 1 >= len(args):  # a malformed driver invocation must still
             # produce a structured line, not a bare traceback
-            print(json.dumps({"metric": "bench_error", "value": None,
-                              "error": f"missing value for {flag}"}),
-                  flush=True)
+            _error_line(f"missing value for {flag}")
             raise SystemExit(2)
         return args[i + 1]
 
@@ -365,18 +425,17 @@ def main():
         if a == "--flash":
             FLASH = _val(i, a).lower()
             if FLASH not in ("auto", "on", "off"):
-                print(json.dumps({"metric": "bench_error", "value": None,
-                                  "error": f"--flash must be auto|on|off, "
-                                           f"got {FLASH!r}"}), flush=True)
+                _error_line(f"--flash must be auto|on|off, got {FLASH!r}")
                 raise SystemExit(2)
     if "--all" in args or model_name == "all":
         model_name = None
 
-    probe = probe_backend()
+    # per-attempt stdout lines only in driver-facing sweep mode: a child
+    # (--model) printing interim probe errors would poison its parent's
+    # last-JSON-line parse if a LATER stage crashed without a row
+    probe = probe_backend(emit_stdout=model_name is None)
     if "error" in probe:
-        print(json.dumps({"metric": "bench_error", "value": None,
-                          "unit": "samples/s/chip", "vs_baseline": None,
-                          **probe}), flush=True)
+        _error_line(probe.pop("error"), **probe)
         raise SystemExit(1)
 
     _apply_platform()
@@ -410,9 +469,13 @@ def _subprocess_bench(budget_s):
                                   120 + 8 * iters * 0.3))
         env = dict(os.environ)
         # the parent's probe already rode out any outage; the child's
-        # probe should fail fast inside the parent's timeout
-        env.setdefault("FF_BENCH_PROBE_ATTEMPTS", "2")
-        env.setdefault("FF_BENCH_PROBE_TIMEOUT", "60")
+        # probe must fail fast inside the parent's timeout, so these
+        # override any operator-exported knobs (ADVICE r4 #1: setdefault
+        # let an inherited 6x150s budget exceed the child timeout and
+        # turn a structured probe failure into a "killed after Ns")
+        env["FF_BENCH_PROBE_ATTEMPTS"] = "2"
+        env["FF_BENCH_PROBE_TIMEOUT"] = "60"
+        env["FF_BENCH_MAX_WAIT"] = "150"  # 2 x 60s + 30s backoff
         try:
             p = subprocess.run(cmd, capture_output=True, text=True,
                                timeout=timeout, env=env)

@@ -82,10 +82,93 @@ def test_subprocess_bench_timeout_carries_child_output(monkeypatch):
         assert "probe 1 fail" in msg and "hang in compile" in msg
 
 
-def test_probe_failure_is_structured_not_hang():
+def test_probe_failure_is_structured_not_hang(capsys):
     # a 1ms timeout kills the probe subprocess before jax can import:
     # exactly the down-tunnel hang path, compressed
     out = bench.probe_backend(attempts=2, timeout=0.001,
-                              backoffs=(0.0,))
+                              backoffs=(0.0,), max_wait=3600.0)
     assert "error" in out and out["attempts"] == 2
     assert "hang" in out["error"]
+    # default (child / scripts reuse): NO stdout pollution — an interim
+    # probe line in a child's stdout would let _parse_child_row blame a
+    # later crash on a transient probe blip
+    assert capsys.readouterr().out.strip() == ""
+    # VERDICT r4 #1, driver-facing sweep mode: EVERY failed attempt
+    # leaves a parseable stdout line, so a driver that kills us mid-probe
+    # still gets a structured record
+    out = bench.probe_backend(attempts=2, timeout=0.001,
+                              backoffs=(0.0,), max_wait=3600.0,
+                              emit_stdout=True)
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()]
+    assert len(lines) == 2
+    assert all(ln["metric"] == "bench_error" for ln in lines)
+    assert lines[-1]["probe_attempt"] == 2 and "hang" in lines[-1]["error"]
+
+
+def test_probe_recovery_supersedes_stale_error_line(capsys, monkeypatch):
+    # attempt 1 hangs, attempt 2 succeeds: sweep mode must print a
+    # bench_probe line so a driver kill during the first (silent) bench
+    # leg doesn't parse the stale attempt-1 error as the outcome
+    import subprocess as sp
+    import types
+
+    calls = {"n": 0}
+
+    def fake_run(cmd, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise sp.TimeoutExpired(cmd, kw["timeout"])
+        return types.SimpleNamespace(
+            stdout='FFPROBE {"n": 1, "kind": "TPU v5 lite"}\n',
+            returncode=0, stderr="")
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    out = bench.probe_backend(attempts=3, timeout=5.0, backoffs=(0.0,),
+                              max_wait=3600.0, emit_stdout=True)
+    assert out == {"n": 1, "kind": "TPU v5 lite"}
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()]
+    assert [ln["metric"] for ln in lines] == ["bench_error", "bench_probe"]
+    assert lines[-1]["recovered_after"] == 1
+
+    # healthy first-try probe ALSO leaves a parseable line (a driver kill
+    # during the first silent bench leg must not parse as null)
+    out = bench.probe_backend(attempts=3, timeout=5.0, backoffs=(0.0,),
+                              max_wait=3600.0, emit_stdout=True)
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()]
+    assert [ln["metric"] for ln in lines] == ["bench_probe"]
+    assert lines[0]["recovered_after"] == 0 and lines[0]["value"] == 1
+
+
+def test_probe_max_wait_caps_wall_clock():
+    # a backoff far beyond the cap: the probe must stop after attempt 1
+    # instead of sleeping the driver's budget away
+    out = bench.probe_backend(attempts=6, timeout=0.001,
+                              backoffs=(9999.0,), max_wait=0.5)
+    assert out["attempts"] == 1
+    assert "FF_BENCH_MAX_WAIT" in out["error"]
+
+
+def test_subprocess_bench_overrides_inherited_probe_knobs(monkeypatch):
+    # ADVICE r4 #1: operator-exported probe knobs must not leak into the
+    # child, whose probe budget has to fit inside its own kill timeout
+    import types
+
+    captured = {}
+
+    def fake_run(cmd, **kw):
+        captured.update(kw["env"])
+        return types.SimpleNamespace(
+            stdout='{"metric": "m", "value": 1.0}\n', returncode=0,
+            stderr="")
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setenv("FF_BENCH_PROBE_ATTEMPTS", "6")
+    monkeypatch.setenv("FF_BENCH_PROBE_TIMEOUT", "150")
+    row = bench._subprocess_bench(300.0)("alexnet", 0, 20)
+    assert row == {"metric": "m", "value": 1.0}
+    assert captured["FF_BENCH_PROBE_ATTEMPTS"] == "2"
+    assert captured["FF_BENCH_PROBE_TIMEOUT"] == "60"
+    assert captured["FF_BENCH_MAX_WAIT"] == "150"
