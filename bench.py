@@ -240,9 +240,11 @@ def probe_backend(attempts=None, timeout=None,
     total probe wall-clock (attempt timeouts + backoffs) is capped by
     ``FF_BENCH_MAX_WAIT`` (seconds) so the operator can size the outage
     armor under the driver's own timeout.  ``emit_stdout`` stays False
-    for child benches (``--model``) and the scripts/ reusers — an interim
-    probe line in a child's stdout would let ``_parse_child_row``
-    misattribute a later crash to a transient probe blip."""
+    for children of ``_subprocess_bench`` (marked via ``FF_BENCH_CHILD``)
+    and the scripts/ reusers — an interim probe line in a child's stdout
+    would let ``_parse_child_row`` misattribute a later crash to a
+    transient probe blip.  A DIRECT ``--model`` run keeps the stdout
+    guarantee: the driver may invoke one under its own timeout."""
     import os
     attempts = attempts or int(os.environ.get("FF_BENCH_PROBE_ATTEMPTS", 6))
     timeout = timeout or float(os.environ.get("FF_BENCH_PROBE_TIMEOUT", 150))
@@ -250,6 +252,13 @@ def probe_backend(attempts=None, timeout=None,
         max_wait = float(os.environ.get("FF_BENCH_MAX_WAIT", 2400))
     t0 = time.monotonic()
     last = "no attempt made"
+    if emit_stdout:
+        # a kill DURING attempt 1 must still leave parseable stdout —
+        # without this line the round-4 rc=124/parsed:null symptom
+        # survives for drivers whose budget is under one probe timeout
+        _error_line("probe attempt 1 in progress (this line is last only "
+                    "if the driver killed the probe mid-attempt)",
+                    probe_attempt=0)
 
     def _exhausted(n):
         return {"error": f"backend unavailable: probe window "
@@ -430,10 +439,13 @@ def main():
     if "--all" in args or model_name == "all":
         model_name = None
 
-    # per-attempt stdout lines only in driver-facing sweep mode: a child
-    # (--model) printing interim probe errors would poison its parent's
-    # last-JSON-line parse if a LATER stage crashed without a row
-    probe = probe_backend(emit_stdout=model_name is None)
+    # per-attempt stdout lines in every driver-facing mode (sweep OR a
+    # direct --model run under the driver's own timeout) — suppressed
+    # only for children of _subprocess_bench (FF_BENCH_CHILD), where an
+    # interim probe line would poison the parent's last-JSON-line parse
+    # if a LATER stage crashed without a row
+    probe = probe_backend(
+        emit_stdout=not os.environ.get("FF_BENCH_CHILD"))
     if "error" in probe:
         _error_line(probe.pop("error"), **probe)
         raise SystemExit(1)
@@ -476,6 +488,7 @@ def _subprocess_bench(budget_s):
         env["FF_BENCH_PROBE_ATTEMPTS"] = "2"
         env["FF_BENCH_PROBE_TIMEOUT"] = "60"
         env["FF_BENCH_MAX_WAIT"] = "150"  # 2 x 60s + 30s backoff
+        env["FF_BENCH_CHILD"] = "1"  # suppress interim probe stdout lines
         try:
             p = subprocess.run(cmd, capture_output=True, text=True,
                                timeout=timeout, env=env)

@@ -105,8 +105,11 @@ int main(int argc, char** argv) {
   }
   int iters = 4 * epochs;
   double t0 = now_s();
-  for (int it = 0; it < iters; it++)
+  for (int it = 0; it < iters; it++) {
     loss = flexflow_model_train_batch(model, 1, inputs, yb);
+    if (isnan(loss)) break; /* a failed step must abort the timing loop,
+                             * not be timed into the THROUGHPUT line */
+  }
   double dt = now_s() - t0;
   if (isnan(loss)) {
     fprintf(stderr, "train failed: %s\n", flexflow_last_error());

@@ -22,12 +22,13 @@ from __future__ import annotations
 
 import math
 import random
+import warnings
 from typing import Dict, List, Optional, Tuple
 
 from ..config import FFConfig, ParallelConfig
 from ..op import Op
 from ..parallel.mesh import AXES, dim_axis_names, expressible_degrees
-from .cost_model import DEFAULT_SPEC, DeviceSpec
+from .cost_model import DEFAULT_SPEC, DeviceSpec, spec_for_device
 from .simulator import Simulator
 
 MeshShape = Dict[str, int]
@@ -179,13 +180,16 @@ def aligned_for_mesh(layers: List[Op],
     return strat
 
 
+_UNSET = object()  # distinguishes "kwarg not passed" from "passed default"
+
+
 def search(layers: List[Op], num_devices: int, budget: int = 1000,
            alpha: float = 0.05, seed: int = 0,
-           spec: Optional[DeviceSpec] = None, measure: bool = False,
+           spec=_UNSET, measure=_UNSET,
            overlap_backward_update: bool = False,
-           verbose: bool = False, flash_attention=None,
-           devices_per_slice: int = 0, remat: bool = False,
-           compute_dtype: str = "bfloat16", conv_layout: str = "auto",
+           verbose: bool = False, flash_attention=_UNSET,
+           devices_per_slice=_UNSET, remat=_UNSET,
+           compute_dtype=_UNSET, conv_layout=_UNSET,
            sim: Optional[Simulator] = None
            ) -> Tuple[Dict[str, ParallelConfig], MeshShape, float]:
     """Run the annealing loop; returns (best strategies, best mesh
@@ -196,25 +200,54 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
     share a Simulator (and, in measure mode, its on-chip measurement
     cache) with its own baseline evaluations."""
     rng = random.Random(seed)
+    # one (name, value) table serves both branches: the contradiction
+    # check against a shared sim AND the pass-through construction —
+    # a new Simulator-mirrored kwarg is added in exactly one place
+    _kwargs = (("measure", measure), ("spec", spec), ("remat", remat),
+               ("flash_attention", flash_attention),
+               ("devices_per_slice", devices_per_slice),
+               ("compute_dtype", compute_dtype),
+               ("conv_layout", conv_layout))
     if sim is not None:
         # the shared sim's config IS the objective; contradicting kwargs
         # would silently split seed-ranking from the acceptance test
-        assert measure == sim.measure or not measure, \
-            f"measure={measure} contradicts shared sim.measure={sim.measure}"
         assert num_devices == sim.num_devices, \
             (f"num_devices={num_devices} contradicts shared "
              f"sim.num_devices={sim.num_devices}")
-        measure = sim.measure
-        spec, remat = sim.spec, sim.remat
-        flash_attention = sim.flash_attention
-        devices_per_slice = sim.devices_per_slice
-        compute_dtype, conv_layout = sim.compute_dtype, sim.conv_layout
+        # measure=True cannot be honored by an analytic sim — the caller
+        # would record analytic times as chip-measured; hard error, not
+        # a warning a batch log swallows
+        assert not (measure is True and not sim.measure), \
+            f"measure=True contradicts shared sim.measure={sim.measure}"
+        # warn on every other EXPLICIT contradicting kwarg (sentinel
+        # defaults distinguish "not passed" from "passed the default",
+        # ADVICE r4 #2), comparing AFTER the same normalization
+        # Simulator.__init__ applies — raw-kwarg comparison would warn
+        # on agreeing calls
+        _norm = {"spec": lambda v: spec_for_device() if v is None else v,
+                 "devices_per_slice": lambda v: v or num_devices}
+        for _name, _given in _kwargs:
+            if _given is _UNSET:
+                continue
+            _given = _norm.get(_name, lambda v: v)(_given)
+            _sims = getattr(sim, _name)
+            if _given != _sims:
+                warnings.warn(
+                    f"search(sim=...) ignores {_name}={_given!r}; the "
+                    f"shared sim's {_name}={_sims!r} defines the objective",
+                    stacklevel=2)
     else:
-        sim = Simulator(
-            spec=spec, num_devices=num_devices, measure=measure,
-            flash_attention=flash_attention,
-            devices_per_slice=devices_per_slice, remat=remat,
-            compute_dtype=compute_dtype, conv_layout=conv_layout)
+        # pass only explicit kwargs; Simulator supplies its own defaults
+        # (no duplicated default table to drift)
+        sim = Simulator(num_devices=num_devices,
+                        **{k: v for k, v in _kwargs if v is not _UNSET})
+    # the sim (shared or freshly built) is the single source of truth;
+    # rank_sim below rebuilds from these locals
+    measure = sim.measure
+    spec, remat = sim.spec, sim.remat
+    flash_attention = sim.flash_attention
+    devices_per_slice = sim.devices_per_slice
+    compute_dtype, conv_layout = sim.compute_dtype, sim.conv_layout
     meshes = candidate_meshes(num_devices)
 
     def dp_mesh() -> MeshShape:

@@ -1,0 +1,21 @@
+#!/bin/bash
+# Drain the round-4 queued chip experiments (artifacts/ROUND4_STATUS.md).
+# Each step logs to artifacts/r5/ and is individually timed + survivable.
+cd /root/repo
+export FF_BENCH_PROBE_ATTEMPTS=1 FF_BENCH_PROBE_TIMEOUT=60
+R=artifacts/r5
+run() {
+  name=$1; shift
+  echo "=== $name : $* : start $(date +%T) ===" | tee -a $R/drain.log
+  timeout "${STEP_TIMEOUT:-1500}" "$@" > "$R/$name.log" 2>&1
+  echo "=== $name : rc=$? : end $(date +%T) ===" | tee -a $R/drain.log
+}
+run calibrate       python scripts/calibrate_cost_model.py
+run bottleneck_inc  python scripts/model_bottleneck.py --model inception_v3
+run flash_off       python bench.py --model transformer --flash off
+run flash_on        python bench.py --model transformer --flash on
+run flash_on_b64    python bench.py --model transformer --flash on --batch 64
+run bottleneck_tx   python scripts/model_bottleneck.py --model transformer
+STEP_TIMEOUT=2400 run search_measure python scripts/search_vs_dp.py --measure
+STEP_TIMEOUT=3000 run sweep          python bench.py
+echo "DRAIN COMPLETE $(date +%T)" | tee -a $R/drain.log

@@ -93,16 +93,19 @@ def test_probe_failure_is_structured_not_hang(capsys):
     # probe line in a child's stdout would let _parse_child_row blame a
     # later crash on a transient probe blip
     assert capsys.readouterr().out.strip() == ""
-    # VERDICT r4 #1, driver-facing sweep mode: EVERY failed attempt
-    # leaves a parseable stdout line, so a driver that kills us mid-probe
-    # still gets a structured record
+    # VERDICT r4 #1, driver-facing sweep mode: an up-front line BEFORE
+    # attempt 1 (a kill during the first attempt must not leave empty
+    # stdout), then EVERY failed attempt leaves a parseable line, so a
+    # driver that kills us anywhere mid-probe still gets a structured
+    # record
     out = bench.probe_backend(attempts=2, timeout=0.001,
                               backoffs=(0.0,), max_wait=3600.0,
                               emit_stdout=True)
     lines = [json.loads(ln) for ln in
              capsys.readouterr().out.strip().splitlines()]
-    assert len(lines) == 2
+    assert len(lines) == 3
     assert all(ln["metric"] == "bench_error" for ln in lines)
+    assert lines[0]["probe_attempt"] == 0  # pre-attempt armor line
     assert lines[-1]["probe_attempt"] == 2 and "hang" in lines[-1]["error"]
 
 
@@ -129,17 +132,20 @@ def test_probe_recovery_supersedes_stale_error_line(capsys, monkeypatch):
     assert out == {"n": 1, "kind": "TPU v5 lite"}
     lines = [json.loads(ln) for ln in
              capsys.readouterr().out.strip().splitlines()]
-    assert [ln["metric"] for ln in lines] == ["bench_error", "bench_probe"]
+    assert [ln["metric"] for ln in lines] == ["bench_error", "bench_error",
+                                              "bench_probe"]
+    assert lines[0]["probe_attempt"] == 0  # pre-attempt armor line
     assert lines[-1]["recovered_after"] == 1
 
-    # healthy first-try probe ALSO leaves a parseable line (a driver kill
-    # during the first silent bench leg must not parse as null)
+    # healthy first-try probe ALSO leaves a parseable success line (a
+    # driver kill during the first silent bench leg must not parse as
+    # null OR as the stale pre-attempt armor line)
     out = bench.probe_backend(attempts=3, timeout=5.0, backoffs=(0.0,),
                               max_wait=3600.0, emit_stdout=True)
     lines = [json.loads(ln) for ln in
              capsys.readouterr().out.strip().splitlines()]
-    assert [ln["metric"] for ln in lines] == ["bench_probe"]
-    assert lines[0]["recovered_after"] == 0 and lines[0]["value"] == 1
+    assert [ln["metric"] for ln in lines] == ["bench_error", "bench_probe"]
+    assert lines[-1]["recovered_after"] == 0 and lines[-1]["value"] == 1
 
 
 def test_probe_max_wait_caps_wall_clock():
@@ -172,3 +178,24 @@ def test_subprocess_bench_overrides_inherited_probe_knobs(monkeypatch):
     assert captured["FF_BENCH_PROBE_ATTEMPTS"] == "2"
     assert captured["FF_BENCH_PROBE_TIMEOUT"] == "60"
     assert captured["FF_BENCH_MAX_WAIT"] == "150"
+
+
+def test_subprocess_bench_marks_children(monkeypatch):
+    """Direct --model runs are driver-facing and keep the per-attempt
+    stdout guarantee; only _subprocess_bench children (FF_BENCH_CHILD)
+    suppress it (code-review r5: model_name was the wrong
+    discriminator)."""
+    captured = {}
+
+    def fake_run(cmd, capture_output, text, timeout, env):
+        captured.update(env)
+
+        class P:
+            stdout = json.dumps({"metric": "x", "value": 1.0}) + "\n"
+            returncode = 0
+            stderr = ""
+        return P()
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    bench._subprocess_bench(600.0)("alexnet", 0, 5)
+    assert captured["FF_BENCH_CHILD"] == "1"

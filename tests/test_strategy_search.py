@@ -230,3 +230,27 @@ def test_spec_for_device_auto_select():
     assert spec_for_device("TPU v5e") is V5E_SPEC
     assert spec_for_device("TPU v5p") is DEFAULT_SPEC
     assert spec_for_device("cpu") is DEFAULT_SPEC
+
+
+def test_shared_sim_contradicting_kwargs_warn():
+    """ADVICE r4 #2: search(sim=...) overrides spec/remat/flash/
+    devices_per_slice/compute_dtype/conv_layout with the sim's values —
+    a caller passing a contradicting non-default kwarg must be warned,
+    and a caller passing matching (or default) kwargs must not be."""
+    import warnings
+    layers = _mlp_layers()
+    sim = Simulator(num_devices=8)
+    with pytest.warns(UserWarning, match="conv_layout"):
+        search(layers, num_devices=8, budget=2, sim=sim,
+               conv_layout="nhwc")
+    # an EXPLICITLY passed documented default that the sim contradicts
+    # must warn too (the sentinel distinguishes it from "not passed")
+    sim_remat = Simulator(num_devices=8, remat=True)
+    with pytest.warns(UserWarning, match="remat"):
+        search(layers, num_devices=8, budget=2, sim=sim_remat, remat=False)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        search(layers, num_devices=8, budget=2, sim=sim)
+        search(layers, num_devices=8, budget=2, sim=sim,
+               conv_layout=sim.conv_layout)
+        search(layers, num_devices=8, budget=2, sim=sim_remat)
