@@ -474,14 +474,13 @@ class FFModel:
     # ------------------------------------------------------------------
     # execution engine
     # ------------------------------------------------------------------
-    def _execute(self, params: Dict[str, jax.Array],
-                 inputs: Dict[int, jax.Array], ctx: OpContext,
-                 constrain: bool) -> Dict[int, jax.Array]:
-        """Topological interpretation of the layer list inside the jit trace
+    def _run_ops(self, ops, params, values: Dict[int, jax.Array],
+                 ctx: OpContext, constrain: bool) -> None:
+        """Interpret a (sub)sequence of the layer list into ``values``
         (the reference's per-op IndexLauncher loop, model.cc:903-907,
-        flattened into one XLA program)."""
-        values: Dict[int, jax.Array] = dict(inputs)
-        for op in self.layers:
+        flattened into one XLA program) — shared by the plain and
+        remat-segmented executors."""
+        for op in ops:
             in_vals = [values[t.uid] for t in op.inputs]
             out_vals = op.forward(params, in_vals, ctx)
             for t, v in zip(op.outputs, out_vals):
@@ -490,15 +489,81 @@ class FFModel:
                     v = jax.lax.with_sharding_constraint(
                         v, self.mesh.sharding(spec))
                 values[t.uid] = v
+
+    def _execute(self, params: Dict[str, jax.Array],
+                 inputs: Dict[int, jax.Array], ctx: OpContext,
+                 constrain: bool) -> Dict[int, jax.Array]:
+        values: Dict[int, jax.Array] = dict(inputs)
+        self._run_ops(self.layers, params, values, ctx, constrain)
+        return values
+
+    def _execute_remat(self, params: Dict[str, jax.Array],
+                       inputs: Dict[int, jax.Array], ctx: OpContext,
+                       constrain: bool,
+                       keep_uids) -> Dict[int, jax.Array]:
+        """sqrt(N)-segmented rematerialization: the layer list is split
+        into ~sqrt(N) segments and each segment's forward is wrapped in
+        ``jax.checkpoint``, so only segment-BOUNDARY tensors survive to
+        the backward pass and a segment's interior is recomputed when its
+        backward runs.  (A single whole-forward ``jax.checkpoint`` — the
+        previous implementation — saves nothing: the backward's first
+        step rematerializes every residual at once, and XLA's own
+        ``memory_analysis()`` reports an unchanged high-water.)  Returns
+        only boundary tensors + ``keep_uids`` — returning every
+        intermediate would pin it as a saved output."""
+        import dataclasses as dc
+        import math as _math
+
+        layers = self.layers
+        n = len(layers)
+        nseg = max(2, _math.isqrt(n))
+        bounds = [round(i * n / nseg) for i in range(nseg + 1)]
+        segments = [layers[a:b] for a, b in zip(bounds, bounds[1:]) if b > a]
+        keep = set(keep_uids)
+        # uids each segment consumes from OUTSIDE itself / produces
+        seg_in, seg_out = [], []
+        for seg in segments:
+            produced = {t.uid for op in seg for t in op.outputs}
+            seg_in.append({t.uid for op in seg for t in op.inputs}
+                          - produced)
+            seg_out.append(produced)
+        values: Dict[int, jax.Array] = dict(inputs)
+        for i, seg in enumerate(segments):
+            needed_later = set(keep)
+            for j in range(i + 1, len(segments)):
+                needed_later |= seg_in[j]
+            in_uids = sorted(u for u in seg_in[i] if u in values)
+            out_uids = sorted(seg_out[i] & needed_later)
+
+            def seg_fn(params, carry, seg=seg, in_uids=in_uids,
+                       out_uids=out_uids):
+                ictx = dc.replace(ctx, updates={}, aux_losses={})
+                vals = dict(zip(in_uids, carry))
+                self._run_ops(seg, params, vals, ictx, constrain)
+                return ([vals[u] for u in out_uids],
+                        ictx.updates, ictx.aux_losses)
+
+            # the LAST segment runs un-checkpointed: its activations are
+            # consumed immediately by the first backward step, so saving
+            # them is free and recomputing them pure waste
+            fn = seg_fn if i == len(segments) - 1 else jax.checkpoint(seg_fn)
+            outs, upd, aux = fn(params, tuple(values[u] for u in in_uids))
+            ctx.updates.update(upd)
+            ctx.aux_losses.update(aux)
+            values.update(zip(out_uids, outs))
         return values
 
     def _split_params(self):
         trainable = {p.name for p in self.parameters if p.trainable}
         return trainable
 
-    def _forward_values(self, params, batch_inputs, ctx):
-        return self._execute(params, batch_inputs, ctx, constrain=(
-            self.mesh is not None and self.mesh.is_distributed))
+    def _forward_values(self, params, batch_inputs, ctx, keep_uids=None):
+        constrain = self.mesh is not None and self.mesh.is_distributed
+        if self.config.remat and keep_uids is not None \
+                and len(self.layers) > 3:
+            return self._execute_remat(params, batch_inputs, ctx,
+                                       constrain, keep_uids)
+        return self._execute(params, batch_inputs, ctx, constrain=constrain)
 
     def _build_step_fns(self) -> None:
         cfg = self.config
@@ -518,13 +583,12 @@ class FFModel:
                             flash_attention=cfg.flash_attention,
                             conv_layout=conv_layout)
             inputs = {uid: x for uid, x in zip(input_uids, batch[:-1])}
-            values = self._forward_values(params, inputs, ctx)
+            # under cfg.remat, _forward_values runs sqrt(N)-segmented
+            # jax.checkpoint and returns only boundaries + these uids
+            values = self._forward_values(params, inputs, ctx,
+                                          keep_uids=(loss_uid, final_uid))
             aux = sum(ctx.aux_losses.values()) if ctx.aux_losses else 0.0
             return values[loss_uid], values[final_uid], ctx.updates, aux
-
-        if cfg.remat:
-            forward_full = jax.checkpoint(forward_full,
-                                          static_argnums=(3,))
 
         def loss_and_metrics(trainable, frozen, batch, rng):
             params = {**frozen, **trainable}

@@ -34,13 +34,20 @@ from .common import cast_compute
 NEG_INF = -1e30  # finite mask value: keeps online-softmax exp() NaN-free
 
 
-def _use_flash(q, k, ctx_flag, training_dropout: bool) -> bool:
-    """Kernel selection.  ``ctx_flag`` None = auto: flash at s >= 1024,
-    the measured v5e crossover (BASELINE.md "Flash attention": flash is
-    2.7-2.8x faster at s=1024..3072 and the only option at s >= 8192
-    where the dense f32 score matrix exceeds HBM; XLA's fused dense
-    attention wins below).  The kernel requires TPU, 128-aligned seq
-    lens, lane-block head_dim, and no attention-prob dropout (it never
+def _use_flash(q, k, ctx_flag, training_dropout: bool,
+               training: bool = True) -> bool:
+    """Kernel selection.  ``ctx_flag`` None = auto: flash at s >= 512
+    when training, s >= 1024 forward-only.  Two measured v5e crossovers
+    feed the split threshold (BASELINE.md "Flash attention"):
+    forward-only, dense wins at s=512 (1.17x) and flash at s >= 1024
+    (2.7-2.8x) — so inference keeps 1024.  The round-5 TRAINING A/B
+    (bench.py --flash on|off, BERT-base s=512) flipped the s=512
+    verdict for the full step: the dense path's O(s^2) f32 score matrix
+    in backward costs more than flash's forward handicap (107.25 ms vs
+    109.09 ms per step, 43.9% vs 43.2% MFU), so training uses 512.
+    Flash is the only option at s >= 8192 where the dense score matrix
+    exceeds HBM.  The kernel requires TPU, 128-aligned seq lens,
+    lane-block head_dim, and no attention-prob dropout (it never
     materializes probabilities)."""
     if training_dropout or jax.default_backend() != "tpu":
         return False
@@ -49,7 +56,7 @@ def _use_flash(q, k, ctx_flag, training_dropout: bool) -> bool:
           and (d < 128 or d % 128 == 0)
           and q.dtype in (jnp.float32, jnp.bfloat16))
     if ctx_flag is None:
-        return ok and max(sq, sk) >= 1024
+        return ok and max(sq, sk) >= (512 if training else 1024)
     return ctx_flag and ok
 
 
@@ -261,7 +268,8 @@ class MultiHeadAttention(Op):
         if self._wants_ring(ctx):
             attn = ring_attention(q, k, v, ctx.mesh, self.causal, scale,
                                   self.dropout if ctx.training else 0.0, rng)
-        elif _use_flash(q, k, ctx.flash_attention, rng is not None):
+        elif _use_flash(q, k, ctx.flash_attention, rng is not None,
+                        training=ctx.training):
             attn = _flash_attention(q, k, v, self.causal, scale)
         else:
             attn = _dense_attention(q, k, v, self.causal, scale,
@@ -306,9 +314,10 @@ class MultiHeadAttention(Op):
         charge for the kernel that will actually run): the flash kernel
         needs no attention-prob dropout, 128-aligned seq lens, and a
         lane-block head_dim; ``flash_attention`` False forces dense, True
-        forces flash where legal, None = auto (s >= 1024).  The backend
-        check in ``_use_flash`` is deliberately absent — the search costs
-        a TPU run even when it executes on the CPU mesh."""
+        forces flash where legal, None = auto (s >= 512 — the TRAINING
+        threshold, since the search objective is a training iteration).
+        The backend check in ``_use_flash`` is deliberately absent — the
+        search costs a TPU run even when it executes on the CPU mesh."""
         n, sq, _ = self.outputs[0].shape
         sk = self.inputs[0].shape[1] if self._self_attn else \
             self.inputs[1].shape[1]
@@ -316,7 +325,7 @@ class MultiHeadAttention(Op):
                        and sq % 128 == 0 and sk % 128 == 0
                        and (self.head_dim < 128 or self.head_dim % 128 == 0))
         if flash_attention is None:
-            flash = flash_legal and max(sq, sk) >= 1024
+            flash = flash_legal and max(sq, sk) >= 512
         else:
             flash = flash_attention and flash_legal
         if flash:

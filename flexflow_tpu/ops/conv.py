@@ -16,6 +16,8 @@ rects.
 
 from __future__ import annotations
 
+import functools
+import os
 from typing import Optional
 
 import jax
@@ -25,6 +27,95 @@ from jax import lax
 from ..initializers import GlorotUniform, ZeroInitializer
 from ..op import Op, OpContext, OpType
 from .common import apply_activation, cast_compute
+
+
+# ---------------------------------------------------------------------------
+# Fast max-pool: XLA lowers the autodiff backward of reduce_window(max) to
+# SelectAndScatter, which serializes badly on TPU — the round-5 on-chip
+# attribution (artifacts/INCEPTION_MFU.md) charged 27% of Inception's step
+# to pool2d, with a single stem pool's backward costing 2.9 ms.  This
+# custom_vjp keeps the reduce_window forward but computes the backward as
+# k*k shifted equality-masks (first-match, cuDNN tie semantics) scattered
+# through interior-dilated pads — all elementwise/VPU work XLA fuses.
+# FF_FAST_POOL=0 restores the autodiff path (chip A/B knob).
+# ---------------------------------------------------------------------------
+
+def _pool_dims(x_ndim, spatial):
+    """Per-dim (window, stride, pad) builders for the two layouts."""
+    def expand(vals, default):
+        full = [default] * x_ndim
+        for d, v in zip(spatial, vals):
+            full[d] = v
+        return tuple(full)
+    return expand
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _fast_max_pool(x, kernel, stride, padding, spatial):
+    """Max pool over the ``spatial`` dims (e.g. (1, 2) for NHWC,
+    (2, 3) for NCHW) of a 4-D array."""
+    expand = _pool_dims(x.ndim, spatial)
+    init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.iinfo(x.dtype).min
+    return lax.reduce_window(
+        x, init, lax.max, expand(kernel, 1), expand(stride, 1),
+        tuple((p, p) for p in expand(padding, 0)))
+
+
+def _fast_max_pool_fwd(x, kernel, stride, padding, spatial):
+    y = _fast_max_pool(x, kernel, stride, padding, spatial)
+    return y, (x, y)
+
+
+def _fast_max_pool_bwd(kernel, stride, padding, spatial, res, g):
+    x, y = res
+    (kh, kw), (sh, sw), (ph, pw) = kernel, stride, padding
+    dh, dw = spatial
+    h, w = x.shape[dh], x.shape[dw]
+    oh, ow = y.shape[dh], y.shape[dw]
+    hp, wp = h + 2 * ph, w + 2 * pw
+
+    def dimtuple(base, vals_h, vals_w):
+        full = list(base)
+        full[dh], full[dw] = vals_h, vals_w
+        return tuple(full)
+
+    neg = jnp.array(-jnp.inf, x.dtype)
+    xp = lax.pad(x, neg, dimtuple([(0, 0, 0)] * x.ndim,
+                                  (ph, ph, 0), (pw, pw, 0)))
+    grad_p = jnp.zeros(dimtuple(x.shape, hp, wp), g.dtype)
+    claimed = jnp.zeros(y.shape, jnp.bool_)
+    zero = jnp.zeros((), g.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            # x value each window sees at offset (i, j):
+            # x_ij[o] = xp[o*s + (i, j)]
+            x_ij = lax.slice(
+                xp, dimtuple([0] * x.ndim, i, j),
+                dimtuple(xp.shape, i + (oh - 1) * sh + 1,
+                         j + (ow - 1) * sw + 1),
+                dimtuple([1] * x.ndim, sh, sw))
+            m = jnp.logical_and(x_ij == y, jnp.logical_not(claimed))
+            claimed = jnp.logical_or(claimed, m)
+            contrib = jnp.where(m, g, zero)
+            # scatter contrib[o] into grad_p[o*s + (i, j)]: interior
+            # dilation by s-1 places outputs on the stride grid, low
+            # padding shifts by the offset (first-match mask = cuDNN
+            # tie semantics)
+            grad_p = grad_p + lax.pad(
+                contrib, zero,
+                dimtuple([(0, 0, 0)] * x.ndim,
+                         (i, hp - ((oh - 1) * sh + 1) - i, sh - 1),
+                         (j, wp - ((ow - 1) * sw + 1) - j, sw - 1)))
+    return (lax.slice(grad_p, dimtuple([0] * x.ndim, ph, pw),
+                      dimtuple(grad_p.shape, ph + h, pw + w)),)
+
+
+_fast_max_pool.defvjp(_fast_max_pool_fwd, _fast_max_pool_bwd)
+
+
+def _use_fast_pool() -> bool:
+    return os.environ.get("FF_FAST_POOL", "1") != "0"
 
 
 class Conv2D(Op):
@@ -148,16 +239,25 @@ class Pool2D(Op):
         ph, pw = self.padding
         if ctx.conv_layout == "nhwc":  # window over dims 1,2; lanes last
             x = jnp.transpose(x, (0, 2, 3, 1))
+            spatial = (1, 2)
             window = (1,) + self.kernel + (1,)
             strides = (1,) + self.stride + (1,)
             padding = ((0, 0), (ph, ph), (pw, pw), (0, 0))
         else:
+            spatial = (2, 3)
             window = (1, 1) + self.kernel
             strides = (1, 1) + self.stride
             padding = ((0, 0), (0, 0), (ph, ph), (pw, pw))
         if self.pool_type == "max":
-            init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
-            y = lax.reduce_window(x, init, lax.max, window, strides, padding)
+            if _use_fast_pool() and jnp.issubdtype(x.dtype, jnp.floating):
+                y = _fast_max_pool(x, self.kernel, self.stride,
+                                   self.padding, spatial)
+            else:
+                init = (-jnp.inf
+                        if jnp.issubdtype(x.dtype, jnp.floating)
+                        else jnp.iinfo(x.dtype).min)
+                y = lax.reduce_window(x, init, lax.max, window, strides,
+                                      padding)
         else:
             s = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
             y = s / (self.kernel[0] * self.kernel[1])

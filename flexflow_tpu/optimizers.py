@@ -32,6 +32,12 @@ import jax.numpy as jnp
 
 
 class Optimizer:
+    #: f32 bytes of optimizer state kept per parameter — consumed by the
+    #: search's HBM legality check (Simulator.peak_memory_bytes), which
+    #: must not pass a strategy the runtime then OOMs on.  Conservative
+    #: default: one momentum-class slot.
+    slot_bytes_per_param: int = 4
+
     def init_state(self, params: Dict[str, jax.Array]) -> Any:
         raise NotImplementedError
 
@@ -50,6 +56,11 @@ class SGDOptimizer(Optimizer):
                  nesterov: bool = False, weight_decay: float = 0.0):
         self.lr, self.momentum = float(lr), float(momentum)
         self.nesterov, self.weight_decay = bool(nesterov), float(weight_decay)
+
+    @property
+    def slot_bytes_per_param(self) -> int:
+        # v_regions exist only when momentum > 0 (optimizer.cc:29-68)
+        return 4 if self.momentum > 0.0 else 0
 
     def init_state(self, params):
         # v_regions created only when momentum > 0 (optimizer.cc:29-68)
@@ -78,6 +89,8 @@ class SGDOptimizer(Optimizer):
 
 
 class AdamOptimizer(Optimizer):
+    slot_bytes_per_param = 8  # m + v, both f32 (optimizer.cc:116-157)
+
     def __init__(self, model=None, alpha: float = 0.001, beta1: float = 0.9,
                  beta2: float = 0.999, weight_decay: float = 0.0,
                  epsilon: float = 1e-8):

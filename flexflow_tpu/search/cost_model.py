@@ -118,7 +118,8 @@ def op_memory_bytes(op: Op, part_degrees: Tuple[int, ...],
                     dtype_bytes: int = 2, opt_slot_bytes: int = 4,
                     axes: Tuple[str, ...] = (),
                     stack_degrees: Dict[str, int] | None = None,
-                    remat: bool = False) -> float:
+                    remat: bool = False,
+                    act_scale: float | None = None) -> float:
     """Per-chip resident bytes one op contributes to the training step's
     high-water mark (reference: the simulator allocates its scratch from
     real FB memory, simulator.cu:82-88, so unfittable strategies are
@@ -134,11 +135,19 @@ def op_memory_bytes(op: Op, part_degrees: Tuple[int, ...],
       SOAP search's candidate meshes pin e=p=1);
     * the op's output activations (retained for backward), divided over
       ALL partition degrees — EXCEPT view/fused ops whose outputs XLA
-      never materializes (``_UNMATERIALIZED_OPS``), and halved under
-      ``remat`` (jax.checkpoint recomputes the forward in backward, so
-      only a checkpointed subset stays resident).
+      never materializes (``_UNMATERIALIZED_OPS``).  Under ``remat``
+      (sqrt(N)-segmented ``jax.checkpoint``, model.py ``_execute_remat``)
+      the resident fraction is ``act_scale``: segment boundaries plus one
+      recomputed segment interior, which the caller that knows the layer
+      count sets to ``2/sqrt(N)`` (``Simulator.peak_memory_bytes``);
+      standalone calls fall back to 0.5, the value of that expression at
+      the ~17-op scale the constant was validated at (saved-residual
+      measurement: boundaries alone are ~0.11x at N=17, plus one
+      interior's recompute ~0.25x, model 0.49x — conservative).
     """
     stack_degrees = stack_degrees or {}
+    if act_scale is None:
+        act_scale = 0.5 if remat else 1.0
     c_deg = 1
     for deg, ax in zip(part_degrees, axes):
         if ax == "c":
@@ -158,7 +167,6 @@ def op_memory_bytes(op: Op, part_degrees: Tuple[int, ...],
             per_param /= c_deg
         total += per_param
     if op.op_type not in _UNMATERIALIZED_OPS:
-        act_scale = 0.5 if remat else 1.0
         for t in op.outputs:
             total += act_scale * t.volume * dtype_bytes / max(1, nparts)
     return total

@@ -17,5 +17,6 @@ run flash_on        python bench.py --model transformer --flash on
 run flash_on_b64    python bench.py --model transformer --flash on --batch 64
 run bottleneck_tx   python scripts/model_bottleneck.py --model transformer
 STEP_TIMEOUT=2400 run search_measure python scripts/search_vs_dp.py --measure
+run memval python scripts/validate_memory_model.py   # compile-only
 STEP_TIMEOUT=3000 run sweep          python bench.py
 echo "DRAIN COMPLETE $(date +%T)" | tee -a $R/drain.log

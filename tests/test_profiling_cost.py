@@ -46,9 +46,15 @@ def test_attention_io_auto_selects_flash_at_1024():
 
 
 def test_attention_io_dense_below_crossover_unless_forced():
+    # round-5 training A/B moved the auto crossover to s >= 512
+    # (BASELINE.md): the search objective is a training step, so auto
+    # at s=512 now costs the flash kernel (zero score-matrix HBM)
     op = _attn(seq=512)
-    assert op.internal_io_bytes(flash_attention=None) == _dense_bytes(op)
+    assert op.internal_io_bytes(flash_attention=None) == 0
     assert op.internal_io_bytes(flash_attention=True) == 0  # legal, forced
+    op384 = _attn(seq=384)
+    assert op384.internal_io_bytes(
+        flash_attention=None) == _dense_bytes(op384)
 
 
 def test_attention_io_dropout_disables_flash():
@@ -77,3 +83,19 @@ def test_cost_model_forwards_flash_flag():
     t_dense = op_compute_time(op, (1,), DEFAULT_SPEC, flash_attention=False)
     assert t_dense > t_flash  # dense pays the score-matrix HBM term
     assert np.isfinite(t_dense) and np.isfinite(t_flash)
+
+
+def test_use_flash_training_vs_inference_threshold(monkeypatch):
+    """Auto selects flash at s >= 512 in training but keeps the
+    forward-only crossover (s >= 1024) for inference, where dense
+    measured 1.17x faster at s=512 (BASELINE.md round-5 A/B)."""
+    import jax.numpy as jnp
+
+    from flexflow_tpu.ops import attention as attn_mod
+
+    monkeypatch.setattr(attn_mod.jax, "default_backend", lambda: "tpu")
+    q = jnp.zeros((2, 512, 4, 64), jnp.bfloat16)
+    assert attn_mod._use_flash(q, q, None, False, training=True)
+    assert not attn_mod._use_flash(q, q, None, False, training=False)
+    q1k = jnp.zeros((2, 1024, 4, 64), jnp.bfloat16)
+    assert attn_mod._use_flash(q1k, q1k, None, False, training=False)
