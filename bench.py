@@ -85,10 +85,12 @@ HBM_BW = {
 }
 
 # internal conv layout for the built models (--conv-layout nchw|nhwc|auto).
-# "auto" resolves per model from the round-4 on-chip A/B (BASELINE.md):
-# NHWC wins on Inception (+1.4 MFU pts), regresses ResNet-50, flat AlexNet.
+# "auto" passes through to the LIBRARY's resolution (op.resolve_conv_layout:
+# NHWC on TPU for concat-heavy graphs — the round-4/5 on-chip A/B says NHWC
+# wins only on Inception), so the harness benches exactly what fit() runs
+# (VERDICT r4 weak #6: the old harness-only BEST_LAYOUT table left library
+# users without the measured win).
 CONV_LAYOUT = "auto"
-BEST_LAYOUT = {"inception_v3": "nhwc"}
 
 # --flash auto|on|off -> config.flash_attention None/True/False.  The
 # round-3 tuning that set auto's s>=1024 threshold timed FORWARD only;
@@ -111,8 +113,7 @@ def build(model_name: str, batch_size: int):
 
     rng = np.random.default_rng(0)
     cfg = ff.FFConfig(batch_size=batch_size, compute_dtype="bfloat16")
-    cfg.conv_layout = (BEST_LAYOUT.get(model_name, "nchw")
-                       if CONV_LAYOUT == "auto" else CONV_LAYOUT)
+    cfg.conv_layout = CONV_LAYOUT  # "auto" resolves in the library
     cfg.flash_attention = {"auto": None, "on": True, "off": False}[FLASH]
     if model_name == "inception_v3":
         from flexflow_tpu.models.inception import build_inception_v3
@@ -392,7 +393,8 @@ def bench_model(model_name, batch_size, iters):
         "mfu": round(achieved / peak, 4) if peak else None,
         "batch_size": batch_size,
         "loss": round(final_loss, 4),
-        "conv_layout": model.config.conv_layout,
+        "conv_layout": getattr(model, "resolved_conv_layout",
+                               model.config.conv_layout),
     }
     if model_name == "dlrm":
         bw = HBM_BW.get(kind)

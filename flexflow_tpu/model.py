@@ -575,7 +575,8 @@ class FFModel:
         loss_uid = self._loss_tensor.uid
         final_uid = self._final_tensor.uid
 
-        conv_layout = resolve_conv_layout(cfg.conv_layout)
+        conv_layout = resolve_conv_layout(cfg.conv_layout, self.layers)
+        self.resolved_conv_layout = conv_layout  # introspection (bench)
 
         def forward_full(params, batch, rng, training):
             ctx = OpContext(training=training, rng=rng,

@@ -118,6 +118,103 @@ def _use_fast_pool() -> bool:
     return os.environ.get("FF_FAST_POOL", "1") != "0"
 
 
+# ---------------------------------------------------------------------------
+# Phase-decomposed stride-s data gradient.  XLA computes the dgrad of a
+# strided conv as a conv over the INTERIOR-DILATED incoming gradient
+# (s-1 zeros between rows/cols) — at stride 2 that wastes ~3/4 of the
+# MACs, and the round-5 calibration measured stem stride-2 convs at
+# 2.6x their roofline fwd+bwd (BASELINE.md).  Decomposing by input-
+# position parity turns the dgrad into s*s dense STRIDE-1 convs of the
+# un-dilated gradient with the filter taps of matching parity — the
+# exact same useful FLOPs, zero waste, all MXU-friendly.  The filter
+# gradient keeps XLA's standard path.  NHWC only (the layout the
+# concat-heavy nets resolve to); FF_FAST_DGRAD=0 restores autodiff.
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _conv_nhwc_fast_dgrad(x, w, stride, padding):
+    """conv_general_dilated NHWC/HWIO with a phase-decomposed dgrad."""
+    return lax.conv_general_dilated(
+        x, w, window_strides=stride,
+        padding=[(padding[0], padding[0]), (padding[1], padding[1])],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _conv_fast_dgrad_fwd(x, w, stride, padding):
+    y = _conv_nhwc_fast_dgrad(x, w, stride, padding)
+    return y, (x, w)
+
+
+def _phase_dgrad(dy, w, x_shape, stride, padding):
+    """dx for NHWC conv via parity-phase stride-1 convs of dy."""
+    n, h, wd, cin = x_shape
+    kh, kw, _, cout = w.shape
+    sh, sw = stride
+    ph, pw = padding
+    oh, ow = dy.shape[1], dy.shape[2]
+    zero = jnp.zeros((), dy.dtype)
+    out = jnp.zeros((n, h, wd, cin), dy.dtype)
+    for rh in range(sh):
+        for rw in range(sw):
+            # taps whose contribution lands on input parity (rh, rw)
+            taps_h = [a for a in range(kh) if a % sh == (rh + ph) % sh]
+            taps_w = [b for b in range(kw) if b % sw == (rw + pw) % sw]
+            hq = (h - rh + sh - 1) // sh  # phase grid extent
+            wq = (wd - rw + sw - 1) // sw
+            if not taps_h or not taps_w or hq <= 0 or wq <= 0:
+                continue
+            # phase filter: selected taps, spatially flipped, in/out
+            # channels swapped -> HWIO with I=cout, O=cin
+            wp = w[jnp.array(taps_h)][:, jnp.array(taps_w)]
+            wp = jnp.transpose(wp[::-1, ::-1], (0, 1, 3, 2))
+            # dx[rh + sh*q] = sum_j dy[q - off_j] * wp_j with integer
+            # offsets; realized as a VALID stride-1 conv over padded dy
+            offs_h = [(a - rh - ph) // sh for a in taps_h]
+            offs_w = [(b - rw - pw) // sw for b in taps_w]
+            # low pad EXACTLY max(offs) and high pad exactly the VALID-
+            # conv remainder — negative values crop (lax.pad edge
+            # padding may be negative); clamping to 0 would misalign
+            # the flipped taps when every offset is negative
+            dyp = lax.pad(dy, zero, (
+                (0, 0, 0),
+                (max(offs_h), hq - 1 - min(offs_h) - (oh - 1), 0),
+                (max(offs_w), wq - 1 - min(offs_w) - (ow - 1), 0),
+                (0, 0, 0)))
+            dxp = lax.conv_general_dilated(
+                dyp, wp, window_strides=(1, 1), padding=[(0, 0), (0, 0)],
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            assert dxp.shape[1] == hq and dxp.shape[2] == wq, (
+                dxp.shape, hq, wq)
+            # interleave onto the (rh::sh, rw::sw) grid via interior-
+            # dilated pad (phases are disjoint, so summation interleaves)
+            out = out + lax.pad(dxp, zero, (
+                (0, 0, 0),
+                (rh, h - ((hq - 1) * sh + rh) - 1, sh - 1),
+                (rw, wd - ((wq - 1) * sw + rw) - 1, sw - 1),
+                (0, 0, 0)))
+    return out
+
+
+def _conv_fast_dgrad_bwd(stride, padding, res, g):
+    x, w = res
+    dx = _phase_dgrad(g, w, x.shape, stride, padding)
+    # filter grad keeps XLA's standard bwd-filter formulation
+    _, w_pullback = jax.vjp(
+        lambda ww: lax.conv_general_dilated(
+            x, ww, window_strides=stride,
+            padding=[(padding[0], padding[0]), (padding[1], padding[1])],
+            dimension_numbers=("NHWC", "HWIO", "NHWC")), w)
+    (dw,) = w_pullback(g)
+    return dx, dw
+
+
+_conv_nhwc_fast_dgrad.defvjp(_conv_fast_dgrad_fwd, _conv_fast_dgrad_bwd)
+
+
+def _use_fast_dgrad() -> bool:
+    return os.environ.get("FF_FAST_DGRAD", "1") != "0"
+
+
 class Conv2D(Op):
     op_type = OpType.CONV2D
 
@@ -162,12 +259,19 @@ class Conv2D(Op):
         # no explicit preferred_element_type: the MXU accumulates bf16 convs
         # in f32 natively, and JAX's conv transpose rule rejects mixed
         # operand/accumulator dtypes in the backward pass
-        y = lax.conv_general_dilated(
-            x, k, window_strides=self.stride,
-            padding=[(ph, ph), (pw, pw)],
-            dimension_numbers=(("NHWC", "HWIO", "NHWC") if nhwc
-                               else ("NCHW", "OIHW", "NCHW")),
-            feature_group_count=self.groups)
+        if (nhwc and self.groups == 1 and max(self.stride) > 1
+                and _use_fast_dgrad()):
+            # strided conv: custom VJP replaces the dilated-dgrad
+            # lowering with parity-phase stride-1 convs (see
+            # _conv_nhwc_fast_dgrad above)
+            y = _conv_nhwc_fast_dgrad(x, k, self.stride, (ph, pw))
+        else:
+            y = lax.conv_general_dilated(
+                x, k, window_strides=self.stride,
+                padding=[(ph, ph), (pw, pw)],
+                dimension_numbers=(("NHWC", "HWIO", "NHWC") if nhwc
+                                   else ("NCHW", "OIHW", "NCHW")),
+                feature_group_count=self.groups)
         if self.use_bias:
             b = params[self.w_bias.name].astype(y.dtype)
             y = y + (b if nhwc else b.reshape(1, -1, 1, 1))

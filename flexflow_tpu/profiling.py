@@ -66,10 +66,14 @@ def profile_op(op: Op, compute_dtype: str = "bfloat16", warmup: int = 2,
     numbers match what fit() actually executes.  ``input_shapes``/
     ``weight_shapes`` override the declared shapes — the simulator's
     measure mode times one PARTITION of the op this way (Op.sub_problem)."""
+    # resolve "auto" against this op alone: a single op is never
+    # concat-heavy, so isolated profiling defaults to NCHW — callers that
+    # know the run's graph (Simulator.measure via optimize_strategies,
+    # model_bottleneck.py) pass the RESOLVED layout instead
     ctx = OpContext(training=True, rng=jax.random.PRNGKey(0),
                     compute_dtype=compute_dtype,
                     flash_attention=flash_attention,
-                    conv_layout=resolve_conv_layout(conv_layout))
+                    conv_layout=resolve_conv_layout(conv_layout, [op]))
     params = _init_params(op, shapes=weight_shapes)
     inputs = _example_inputs(op, shapes=input_shapes)
 

@@ -1,0 +1,20 @@
+#!/bin/bash
+cd /root/repo
+export FF_BENCH_PROBE_ATTEMPTS=1 FF_BENCH_PROBE_TIMEOUT=60
+R=artifacts/r5
+run() {
+  name=$1; shift
+  echo "=== $name : $* : start $(date +%T) ===" >> $R/drain.log
+  timeout "${STEP_TIMEOUT:-1500}" "$@" > "$R/$name.log" 2>&1
+  echo "=== $name : rc=$? : end $(date +%T) ===" >> $R/drain.log
+}
+STEP_TIMEOUT=2400 run search_measure python scripts/search_vs_dp.py --measure
+run memval python scripts/validate_memory_model.py
+STEP_TIMEOUT=3000 run sweep python bench.py
+# fast-pool + fast-dgrad A/B: the round-5 kernel work, measured
+run incep_fast    python bench.py --model inception_v3
+FF_FAST_POOL=0 FF_FAST_DGRAD=0 run incep_ctrl python bench.py --model inception_v3
+run resnet_fast   python bench.py --model resnet50
+run incep_fast2   python bench.py --model inception_v3
+run incep_fast3   python bench.py --model inception_v3
+echo "DRAIN2 COMPLETE $(date +%T)" >> $R/drain.log

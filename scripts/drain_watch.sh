@@ -1,0 +1,21 @@
+#!/bin/bash
+# waits for the orphaned search_measure (pid $1) then runs the rest
+while kill -0 "$1" 2>/dev/null; do sleep 20; done
+cd /root/repo
+export FF_BENCH_PROBE_ATTEMPTS=1 FF_BENCH_PROBE_TIMEOUT=60
+R=artifacts/r5
+run() {
+  name=$1; shift
+  echo "=== $name : $* : start $(date +%T) ===" >> $R/drain.log
+  timeout "${STEP_TIMEOUT:-1500}" "$@" > "$R/$name.log" 2>&1
+  echo "=== $name : rc=$? : end $(date +%T) ===" >> $R/drain.log
+}
+echo "=== search_measure (orphan) finished; continuing $(date +%T) ===" >> $R/drain.log
+run memval        python scripts/validate_memory_model.py
+run incep_fast    python bench.py --model inception_v3
+FF_FAST_POOL=0 FF_FAST_DGRAD=0 run incep_ctrl python bench.py --model inception_v3
+run incep_fast2   python bench.py --model inception_v3
+run incep_fast3   python bench.py --model inception_v3
+run resnet_fast   python bench.py --model resnet50
+STEP_TIMEOUT=3000 run sweep python bench.py
+echo "DRAIN2 COMPLETE $(date +%T)" >> $R/drain.log

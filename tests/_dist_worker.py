@@ -1,67 +1,116 @@
-"""Worker for the 2-process distributed test (launched by
-tests/test_distributed.py).  Each process owns 4 virtual CPU devices; the
-global mesh spans all 8 (the reference's GASNet multi-node shape,
-FlexFlow.mk:68-69, run as multi-controller SPMD).
+"""Worker for the multi-process distributed tests (launched by
+tests/test_distributed.py).  Each process owns ``devices_per_proc``
+virtual CPU devices; the global mesh spans all of them (the reference's
+GASNet multi-node shape, FlexFlow.mk:68-69, run as multi-controller
+SPMD).
 
 argv: <coordinator_port> <process_id> <num_processes> <workdir>
-Writes "<workdir>/loss_<pid>.txt" with the pre-checkpoint and
-post-restore losses.
+      <devices_per_proc> <shape>
+shape: "dp4tp2"     — 8-device n4 x c2 MLP (2 procs x 4 devices)
+       "dp2tp2pp2"  — 8-device n2 x c2 x p2 pipelined transformer
+                      (4 procs x 2 devices; non-adjacent slices and
+                      >1 host per mesh row, the rank-mapping shapes a
+                      2-process run cannot catch)
+Writes "<workdir>/loss_<pid>.txt" with the pre-checkpoint,
+post-save and post-restore losses.
 """
 
 import os
 import sys
 
-port, pid, nprocs, workdir = (sys.argv[1], int(sys.argv[2]),
-                              int(sys.argv[3]), sys.argv[4])
-
-os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                           + " --xla_force_host_platform_device_count=4")
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-
-from flexflow_tpu.parallel.distributed import initialize_distributed  # noqa: E402
-
-assert initialize_distributed(coordinator_address=f"localhost:{port}",
-                              num_processes=nprocs, process_id=pid)
-assert jax.process_count() == nprocs, jax.process_count()
-assert len(jax.devices()) == 4 * nprocs, len(jax.devices())
-
-import numpy as np  # noqa: E402
-
-import flexflow_tpu as ff  # noqa: E402
-
 BATCH = 32
-cfg = ff.FFConfig(batch_size=BATCH, compute_dtype="float32")
-model = ff.FFModel(cfg, mesh=ff.MachineMesh({"n": 4, "c": 2}))
-x = model.create_tensor((BATCH, 16), name="x")
-t = model.dense(x, 32, activation="relu", name="fc1")
-t = model.dense(t, 4, name="fc2")
-model.compile(ff.SGDOptimizer(lr=0.1, momentum=0.9),
-              ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY, ["accuracy"],
-              final_tensor=t)
-model.init_layers(seed=0)
 
-rng = np.random.default_rng(0)  # same feed on every process (SPMD)
-xd = rng.standard_normal((BATCH, 16)).astype(np.float32)
-yd = rng.integers(0, 4, (BATCH, 1)).astype(np.int32)
 
-for _ in range(3):
-    loss = float(model.train_batch(xd, yd))
+def build_model(shape: str):
+    """Same graph on every process AND in the single-process parity
+    check (test_distributed.py imports this)."""
+    import flexflow_tpu as ff
+    from flexflow_tpu.config import ParallelConfig
 
-ckpt = os.path.join(workdir, "dist_ckpt")
-model.save_checkpoint(ckpt)  # proc 0 writes; all procs barrier
+    if shape == "dp4tp2":
+        cfg = ff.FFConfig(batch_size=BATCH, compute_dtype="float32")
+        model = ff.FFModel(cfg, mesh=ff.MachineMesh({"n": 4, "c": 2}))
+        x = model.create_tensor((BATCH, 16), name="x")
+        t = model.dense(x, 32, activation="relu", name="fc1")
+        t = model.dense(t, 4, name="fc2")
+        feed = "dense"
+    elif shape == "dp2tp2pp2":
+        cfg = ff.FFConfig(batch_size=BATCH, compute_dtype="float32")
+        cfg.strategies = {
+            "head": ParallelConfig(dims=(2, 2), device_ids=tuple(range(4))),
+        }
+        model = ff.FFModel(cfg, mesh=ff.MachineMesh({"n": 2, "c": 2,
+                                                     "p": 2}))
+        tok = model.create_tensor((BATCH, 8), dtype="int32", name="tokens")
+        t = model.embedding(tok, 32, 16, aggr="none")
+        t = model.pipeline_transformer_block(t, num_stages=2, num_heads=2,
+                                             d_ff=32)
+        t = model.reshape(model.split(t, [1, 7], axis=1)[0], (BATCH, 16))
+        t = model.dense(t, 4, name="head")
+        feed = "tokens"
+    else:
+        raise ValueError(f"unknown shape {shape!r}")
+    model.compile(ff.SGDOptimizer(lr=0.1, momentum=0.9),
+                  ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY, ["accuracy"],
+                  final_tensor=t)
+    model.init_layers(seed=0)
+    return model, feed
 
-# keep training, then restore: the post-restore step must reproduce the
-# step right after the save
-loss_after_save = float(model.train_batch(xd, yd))
-for _ in range(2):
-    model.train_batch(xd, yd)
-model.load_checkpoint(ckpt)
-loss_after_restore = float(model.train_batch(xd, yd))
 
-with open(os.path.join(workdir, f"loss_{pid}.txt"), "w") as f:
-    f.write(f"{loss} {loss_after_save} {loss_after_restore}\n")
-print(f"proc {pid}: loss={loss:.6f} resume_delta="
-      f"{abs(loss_after_save - loss_after_restore):.2e}")
+def make_batch(feed: str):
+    import numpy as np
+    rng = np.random.default_rng(0)  # same feed on every process (SPMD)
+    if feed == "tokens":
+        xd = rng.integers(0, 32, (BATCH, 8)).astype(np.int32)
+    else:
+        xd = rng.standard_normal((BATCH, 16)).astype(np.float32)
+    yd = rng.integers(0, 4, (BATCH, 1)).astype(np.int32)
+    return xd, yd
+
+
+def main():
+    port, pid, nprocs, workdir = (sys.argv[1], int(sys.argv[2]),
+                                  int(sys.argv[3]), sys.argv[4])
+    dev_per_proc = int(sys.argv[5]) if len(sys.argv) > 5 else 4
+    shape = sys.argv[6] if len(sys.argv) > 6 else "dp4tp2"
+
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={dev_per_proc}")
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from flexflow_tpu.parallel.distributed import initialize_distributed
+
+    assert initialize_distributed(coordinator_address=f"localhost:{port}",
+                                  num_processes=nprocs, process_id=pid)
+    assert jax.process_count() == nprocs, jax.process_count()
+    assert len(jax.devices()) == dev_per_proc * nprocs, len(jax.devices())
+
+    model, feed = build_model(shape)
+    xd, yd = make_batch(feed)
+
+    for _ in range(3):
+        loss = float(model.train_batch(xd, yd))
+
+    ckpt = os.path.join(workdir, "dist_ckpt")
+    model.save_checkpoint(ckpt)  # proc 0 writes; all procs barrier
+
+    # keep training, then restore: the post-restore step must reproduce
+    # the step right after the save
+    loss_after_save = float(model.train_batch(xd, yd))
+    for _ in range(2):
+        model.train_batch(xd, yd)
+    model.load_checkpoint(ckpt)
+    loss_after_restore = float(model.train_batch(xd, yd))
+
+    with open(os.path.join(workdir, f"loss_{pid}.txt"), "w") as f:
+        f.write(f"{loss} {loss_after_save} {loss_after_restore}\n")
+    print(f"proc {pid}: loss={loss:.6f} resume_delta="
+          f"{abs(loss_after_save - loss_after_restore):.2e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -101,3 +101,51 @@ def test_nhwc_composes_with_spatial_sharding():
     losses = _train_convnet("nhwc", mesh_shape={"n": 2, "h": 2, "w": 2})
     ref = _train_convnet("nchw", mesh_shape={"n": 2, "h": 2, "w": 2})
     np.testing.assert_allclose(losses, ref, rtol=1e-4)
+
+
+def test_auto_layout_flips_nhwc_for_concat_heavy_on_tpu(monkeypatch):
+    """VERDICT r4 ask #7: library-level auto must give fit() users the
+    measured NHWC win on Inception-class (concat-heavy) graphs — on TPU
+    only; CPU test meshes stay NCHW for determinism."""
+    import jax
+
+    from flexflow_tpu.op import resolve_conv_layout
+
+    cfg = ff.FFConfig(batch_size=8, compute_dtype="float32")
+    m = ff.FFModel(cfg, mesh=ff.MachineMesh({"n": 1}))
+    x = m.create_tensor((8, 3, 32, 32), name="img")
+    branches = [m.conv2d(x, 8, 1, 1, 1, 1, 0, 0) for _ in range(2)]
+    t = m.concat(branches, axis=1)
+    branches2 = [m.conv2d(t, 8, 3, 3, 1, 1, 1, 1) for _ in range(2)]
+    m.concat(branches2, axis=1)
+    concat_heavy = m.layers
+
+    cfg2 = ff.FFConfig(batch_size=8, compute_dtype="float32")
+    m2 = ff.FFModel(cfg2, mesh=ff.MachineMesh({"n": 1}))
+    x2 = m2.create_tensor((8, 3, 32, 32), name="img")
+    t2 = m2.conv2d(x2, 8, 3, 3, 1, 1, 1, 1)
+    m2.conv2d(t2, 8, 3, 3, 1, 1, 1, 1)
+    plain = m2.layers
+
+    # on the CPU backend both stay nchw
+    assert resolve_conv_layout("auto", concat_heavy) == "nchw"
+    # on TPU, concat-heavy flips, plain does not, explicit always wins
+    # (resolve_conv_layout imports jax lazily, so the module patch holds)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert resolve_conv_layout("auto", concat_heavy) == "nhwc"
+    assert resolve_conv_layout("auto", plain) == "nchw"
+    assert resolve_conv_layout("nchw", concat_heavy) == "nchw"
+    assert resolve_conv_layout("auto") == "nchw"  # no graph: default
+
+
+def test_inception_resolves_nhwc_on_tpu(monkeypatch):
+    """The real Inception-v3 graph crosses the concat threshold."""
+    import jax
+
+    from flexflow_tpu.models.inception import build_inception_v3
+    from flexflow_tpu.op import resolve_conv_layout
+
+    cfg = ff.FFConfig(batch_size=8, compute_dtype="float32")
+    model, _, _ = build_inception_v3(cfg, num_classes=10, image_size=299)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert resolve_conv_layout("auto", model.layers) == "nhwc"

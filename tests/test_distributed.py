@@ -1,11 +1,20 @@
-"""Multi-process distributed runtime test (VERDICT round-2 ask #7).
+"""Multi-process distributed runtime tests (VERDICT round-2 ask #7;
+round-4 ask #9 scales past 2 processes).
 
-Spawns 2 OS processes with a localhost coordinator, 4 virtual CPU devices
-each; the 8-device global mesh is dp4 x tp2.  Verifies (a) both processes
-agree on the loss, (b) checkpoint save/restore across processes reproduces
-the post-save step exactly, (c) the multi-process loss matches a
-single-process run of the identical model — the reference's GASNet
-multi-node path (FlexFlow.mk:68-69) validated without a cluster."""
+Spawns N OS processes with a localhost coordinator and
+``devices_per_proc`` virtual CPU devices each; the global mesh spans
+all of them.  Two shapes:
+
+* 2 procs x 4 devices, dp4 x tp2 MLP — the original multi-host shape;
+* 4 procs x 2 devices, dp2 x tp2 x pp2 pipelined transformer — four
+  processes catch rank-mapping bugs two cannot (non-adjacent device
+  slices, more than one host per mesh row).
+
+Verifies (a) all processes agree on the loss, (b) checkpoint
+save/restore across processes reproduces the post-save step exactly,
+(c) the multi-process loss matches a single-process run of the
+identical model — the reference's GASNet multi-node path
+(FlexFlow.mk:68-69) validated without a cluster."""
 
 import os
 import socket
@@ -24,8 +33,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_mesh_trains_and_resumes(tmp_path):
+def _run_workers(nprocs, dev_per_proc, shape, tmp_path, timeout):
     port = _free_port()
     from tests.subproc import cached_env
     env = cached_env()
@@ -34,41 +42,73 @@ def test_two_process_mesh_trains_and_resumes(tmp_path):
     procs = [
         subprocess.Popen(
             [sys.executable, os.path.join(REPO, "tests", "_dist_worker.py"),
-             str(port), str(i), "2", str(tmp_path)],
+             str(port), str(i), str(nprocs), str(tmp_path),
+             str(dev_per_proc), shape],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True)
-        for i in range(2)
+        for i in range(nprocs)
     ]
-    outs = []
     for p in procs:
-        out, _ = p.communicate(timeout=420)
-        outs.append(out)
+        out, _ = p.communicate(timeout=timeout)
         assert p.returncode == 0, out[-3000:]
     losses = []
-    for i in range(2):
+    for i in range(nprocs):
         with open(tmp_path / f"loss_{i}.txt") as f:
             losses.append([float(v) for v in f.read().split()])
+    return losses
+
+
+def _check(losses):
     # (a) SPMD processes agree bit-for-bit on the replicated loss
-    assert losses[0] == losses[1], losses
+    for other in losses[1:]:
+        assert other == losses[0], losses
     loss, after_save, after_restore = losses[0]
     assert np.isfinite(loss)
     # (b) restore reproduces the post-save step (loss-exact resume)
     assert abs(after_save - after_restore) < 1e-6, losses[0]
+    return loss
 
-    # (c) parity with a single-process run of the identical model
-    import flexflow_tpu as ff
-    cfg = ff.FFConfig(batch_size=32, compute_dtype="float32")
-    model = ff.FFModel(cfg, mesh=ff.MachineMesh({"n": 4, "c": 2}))
-    x = model.create_tensor((32, 16), name="x")
-    t = model.dense(x, 32, activation="relu", name="fc1")
-    t = model.dense(t, 4, name="fc2")
-    model.compile(ff.SGDOptimizer(lr=0.1, momentum=0.9),
-                  ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY, ["accuracy"],
-                  final_tensor=t)
-    model.init_layers(seed=0)
-    rng = np.random.default_rng(0)
-    xd = rng.standard_normal((32, 16)).astype(np.float32)
-    yd = rng.integers(0, 4, (32, 1)).astype(np.int32)
-    for _ in range(3):
-        ref_loss = float(model.train_batch(xd, yd))
+
+def _single_process_reference(shape):
+    """Parity: the identical model on one process with all 8 devices."""
+    code = (
+        "import os\n"
+        "os.environ['XLA_FLAGS'] = "
+        "'--xla_force_host_platform_device_count=8'\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from tests._dist_worker import build_model, make_batch\n"
+        f"model, feed = build_model({shape!r})\n"
+        "xd, yd = make_batch(feed)\n"
+        "for _ in range(3):\n"
+        "    loss = float(model.train_batch(xd, yd))\n"
+        "print('REF_LOSS', loss)\n")
+    from tests.subproc import cached_env
+    env = cached_env()
+    env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=420)
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
+    for line in out.stdout.splitlines():
+        if line.startswith("REF_LOSS "):
+            return float(line.split()[1])
+    raise AssertionError(out.stdout[-2000:])
+
+
+@pytest.mark.slow
+def test_two_process_mesh_trains_and_resumes(tmp_path):
+    losses = _run_workers(2, 4, "dp4tp2", tmp_path, timeout=420)
+    loss = _check(losses)
+    ref_loss = _single_process_reference("dp4tp2")
+    assert abs(ref_loss - loss) < 1e-4, (ref_loss, loss)
+
+
+@pytest.mark.slow
+def test_four_process_pipeline_mesh_trains_and_resumes(tmp_path):
+    """dp2 x tp2 x pp2 over 4 processes x 2 devices (VERDICT r4 ask #9):
+    pipeline stages and TP groups both straddle process boundaries."""
+    losses = _run_workers(4, 2, "dp2tp2pp2", tmp_path, timeout=600)
+    loss = _check(losses)
+    ref_loss = _single_process_reference("dp2tp2pp2")
     assert abs(ref_loss - loss) < 1e-4, (ref_loss, loss)
