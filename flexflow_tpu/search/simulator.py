@@ -94,6 +94,7 @@ class Simulator:
         self.remat = remat  # the run rematerializes: less resident memory
         self.compute_dtype = compute_dtype  # measure the run's dtype
         self.conv_layout = conv_layout  # ... and the run's conv layout
+        self.verbose_measure = False  # 1 line per novel microbenchmark
         self._measure_cache: Dict[Tuple, Tuple[float, float]] = {}
         self._plan_cache: Dict[Tuple, Tuple] = {}
         self._native = None
@@ -106,7 +107,16 @@ class Simulator:
         if self.measure:
             key = (op.name, dims)
             if key not in self._measure_cache:
+                import time as _time
+                t0 = _time.perf_counter()
                 self._measure_cache[key] = self._measure_op(op, dims)
+                if self.verbose_measure:
+                    f, b = self._measure_cache[key]
+                    print(f"# measure[{len(self._measure_cache)}] "
+                          f"{op.name} dims={dims}: fwd {f * 1e3:.3f} ms "
+                          f"bwd {b * 1e3:.3f} ms "
+                          f"({_time.perf_counter() - t0:.0f}s incl. "
+                          f"compile)", flush=True)
             fwd, bwd = self._measure_cache[key]
             return bwd if backward else fwd
         return op_compute_time(op, dims, self.spec, self.dtype_bytes, backward,
@@ -205,22 +215,26 @@ class Simulator:
 
     def peak_memory_bytes(self, layers: List[Op],
                           strategies: Dict[str, ParallelConfig],
-                          mesh_shape: Optional[Dict[str, int]] = None
-                          ) -> float:
+                          mesh_shape: Optional[Dict[str, int]] = None,
+                          assume_remat: Optional[bool] = None) -> float:
         """Per-chip HBM high-water estimate for a strategy: params + grads +
         optimizer slots (sharded over TP degrees) + retained activations
         (sharded over all degrees).  ``mesh_shape`` supplies the e/p axis
         sizes for expert-/stage-stacked weights (absent -> replicated).
+        ``assume_remat`` overrides ``self.remat`` — the legality check
+        passes False (chip evidence: XLA's footprint does not shrink
+        under remat without HBM pressure, BASELINE.md round-5).
         The reference grounds legality in real FB memory
         (simulator.cu:82-88); this is the explicit TPU analogue."""
         from ..parallel.mesh import dim_axis_names
+        remat = self.remat if assume_remat is None else assume_remat
         stack = {a: (mesh_shape or {}).get(a, 1) for a in ("e", "p")}
         # resident activation fraction under sqrt(N)-segmented remat
         # (model.py _execute_remat): ~nseg boundary tensors + one
         # recomputed segment interior of N/nseg ops -> 2/sqrt(N) of the
         # full retained set (validated against jax saved_residuals)
         act_scale = 1.0
-        if self.remat:
+        if remat:
             n_mat = max(1, len(layers))
             act_scale = min(1.0, 2.0 / math.sqrt(n_mat))
         total = 0.0
@@ -235,7 +249,7 @@ class Simulator:
             total += op_memory_bytes(op, dims, self.dtype_bytes,
                                      opt_slot_bytes=self.opt_slot_bytes,
                                      axes=dim_axis_names(out.num_dims),
-                                     stack_degrees=stack, remat=self.remat,
+                                     stack_degrees=stack, remat=remat,
                                      act_scale=act_scale)
         return total
 
@@ -312,8 +326,19 @@ class Simulator:
         score inf (reference: simulator scratch comes from real FB memory,
         simulator.cu:82-88).  Runs the C++ engine when available
         (native/simulator.cpp), else pure Python."""
-        if self.peak_memory_bytes(layers, strategies,
-                                  mesh_shape) > self.spec.hbm_capacity:
+        # XLA_TEMP_FACTOR: the compiler's buffer assignment (scratch +
+        # fusion temps) measured 1.4-2.1x the analytic peak on chip
+        # (BASELINE.md round-5 memory_analysis validation) — legality
+        # must fit the COMPILER's footprint, not the model's.  The same
+        # measurement showed XLA's footprint does NOT shrink under
+        # segmented remat absent HBM pressure, so legality charges the
+        # NO-REMAT activation set (assume_remat=False): whether remat
+        # rescues an otherwise-OOM compile is unverified on chip, and
+        # an optimistic 2/sqrt(N) here would pass strategies that OOM.
+        from .cost_model import XLA_TEMP_FACTOR
+        if (self.peak_memory_bytes(layers, strategies, mesh_shape,
+                                   assume_remat=False)
+                * XLA_TEMP_FACTOR > self.spec.hbm_capacity):
             return float("inf")
         if self._native is not None:
             t = self._simulate_native(layers, strategies,

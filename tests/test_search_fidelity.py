@@ -60,7 +60,10 @@ def test_deep_chain_not_banned_at_realistic_hbm():
     # params: 50 * 2048^2 * 12B = 2.5GB; linear acts: 50 * 1MB = 50MB
     act_bytes = 50 * 256 * 2048 * 2
     # capacity between true residency and the old relu-inflated estimate
-    cap = peak + act_bytes / 2
+    # (legality charges peak * XLA_TEMP_FACTOR, the measured compiler
+    # overhead — BASELINE.md round-5 memory_analysis validation)
+    from flexflow_tpu.search.cost_model import XLA_TEMP_FACTOR
+    cap = (peak + act_bytes / 2) * XLA_TEMP_FACTOR
     tight = DeviceSpec(hbm_capacity=cap)
     assert np.isfinite(Simulator(spec=tight, num_devices=1,
                                  use_native=False

@@ -289,7 +289,8 @@ def test_adam_slot_bytes_flip_legality():
     peak_sgd_m = sgd_m.peak_memory_bytes(layers, dp)
     peak_adam = adam.peak_memory_bytes(layers, dp)
     assert peak_adam > peak_sgd_m
-    budget = (peak_sgd_m + peak_adam) / 2
+    from flexflow_tpu.search.cost_model import XLA_TEMP_FACTOR
+    budget = (peak_sgd_m + peak_adam) / 2 * XLA_TEMP_FACTOR
     spec = dc.replace(DEFAULT_SPEC, hbm_capacity=budget)
     assert np.isfinite(
         Simulator(spec=spec, num_devices=8, opt_slot_bytes=4)
