@@ -88,7 +88,19 @@ class Concat(Op):
 
     def forward(self, params, inputs, ctx):
         dt = jnp.result_type(*[x.dtype for x in inputs])
-        return [jnp.concatenate([x.astype(dt) for x in inputs], axis=self.axis)]
+        xs = [x.astype(dt) for x in inputs]
+        # channels-minor path: a channel concat between NHWC-internal
+        # convs/pools (inception blocks) concatenates on the LANE axis so
+        # the boundary transposes cancel with the neighbors' — the
+        # round-5 on-chip attribution charged early-block concat
+        # backwards 3-4x their roofline to exactly these relayouts
+        # (artifacts/INCEPTION_MFU.md)
+        if (getattr(ctx, "conv_layout", "nchw") == "nhwc"
+                and self.axis == 1 and xs[0].ndim == 4):
+            xs = [jnp.transpose(x, (0, 2, 3, 1)) for x in xs]
+            y = jnp.concatenate(xs, axis=3)
+            return [jnp.transpose(y, (0, 3, 1, 2))]
+        return [jnp.concatenate(xs, axis=self.axis)]
 
     def flops(self):
         return 0

@@ -95,6 +95,7 @@ class Simulator:
         self.compute_dtype = compute_dtype  # measure the run's dtype
         self.conv_layout = conv_layout  # ... and the run's conv layout
         self.verbose_measure = False  # 1 line per novel microbenchmark
+        self._warned_remat_legality = False
         self._measure_cache: Dict[Tuple, Tuple[float, float]] = {}
         self._plan_cache: Dict[Tuple, Tuple] = {}
         self._native = None
@@ -339,6 +340,16 @@ class Simulator:
         if (self.peak_memory_bytes(layers, strategies, mesh_shape,
                                    assume_remat=False)
                 * XLA_TEMP_FACTOR > self.spec.hbm_capacity):
+            if self.remat and not self._warned_remat_legality:
+                self._warned_remat_legality = True
+                import warnings
+                warnings.warn(
+                    "HBM legality charges the NO-REMAT activation set "
+                    "even though this Simulator has remat=True: on-chip "
+                    "memory_analysis showed XLA's footprint does not "
+                    "shrink under segmented remat (BASELINE.md round-5); "
+                    "strategies scoring inf here may still compile with "
+                    "remat, but that is unverified", stacklevel=2)
             return float("inf")
         if self._native is not None:
             t = self._simulate_native(layers, strategies,

@@ -165,10 +165,18 @@ def main():
               f"searched {t_best * 1e3:.3f} ms ({speedup:.2f}x), "
               f"mesh {mesh}, {n_hybrid}/{len(layers)} ops non-DP, "
               f"{wall:.0f}s search wall-clock", flush=True)
+        # write BEFORE the assert: a failing config's row (hours of
+        # on-chip microbenchmarks) must reach disk either way, and a
+        # window kill mid-run still leaves the completed rows
+        write_md(rows, budget, out_dir)
         # measured objective carries microbenchmark noise; 5% slack there
         assert t_best <= t_dp * (1.05 if MEASURE else 1.001), \
             (name, t_best, t_dp)
 
+    print("done")
+
+
+def write_md(rows, budget, out_dir):
     md = os.path.join(out_dir,
                       "SEARCH_VS_DP_MEASURED.md" if MEASURE
                       else "SEARCH_VS_DP.md")
@@ -193,18 +201,21 @@ def main():
             "2048-wide LSTM + 20k-vocab head), scale-out (32 devices), "
             "and small per-chip batch.\n\n"
             "| workload | batch | devices | DP (ms/iter) | searched "
-            "(ms/iter) | speedup | analytic-winner (ms) | mesh | "
-            "non-DP ops | strategy file |\n"
-            "|---|---|---|---|---|---|---|---|---|---|\n")
+            "(ms/iter) | speedup | "
+            + ("analytic-winner (ms) | " if MEASURE else "")
+            + "mesh | non-DP ops | strategy file |\n"
+            + "|---|---|---|---|---|---|---|---|---|"
+            + ("---|" if MEASURE else "") + "\n")
         for (name, batch, ndev, dp_ms, best_ms, sp, mesh, nh, nl, wall,
              pb, t_aw) in rows:
-            aw = f"{t_aw * 1e3:.3f}" if t_aw is not None else "—"
+            aw = (f"{t_aw * 1e3:.3f} | " if t_aw is not None else "— | ") \
+                if MEASURE else ""
             f.write(f"| {name} | {batch} | {ndev} | {dp_ms:.3f} | "
-                    f"{best_ms:.3f} | **{sp:.2f}x** | {aw} | `{mesh}` | "
+                    f"{best_ms:.3f} | **{sp:.2f}x** | {aw}`{mesh}` | "
                     f"{nh}/{nl} | `{pb}` |\n")
         f.write("\nReproduce: `python scripts/search_vs_dp.py "
                 f"{'--measure ' if MEASURE else ''}--budget {budget}`.\n")
-    print(f"wrote {md}")
+    print(f"wrote {md}", flush=True)
 
 
 if __name__ == "__main__":

@@ -149,3 +149,31 @@ def test_inception_resolves_nhwc_on_tpu(monkeypatch):
     model, _, _ = build_inception_v3(cfg, num_classes=10, image_size=299)
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     assert resolve_conv_layout("auto", model.layers) == "nhwc"
+
+
+def test_concat_block_trains_identically_in_both_layouts():
+    """Inception-style branch + channel-concat block: the NHWC concat
+    path (lane-axis concatenation, round-5 relayout fix) must be
+    numerically identical to NCHW, forward and through training."""
+    def train(conv_layout, steps=3):
+        cfg = ff.FFConfig(batch_size=8, compute_dtype="float32")
+        cfg.conv_layout = conv_layout
+        model = ff.FFModel(cfg)
+        x = model.create_tensor((8, 3, 16, 16), name="img")
+        b1 = model.conv2d(x, 8, 1, 1, 1, 1, 0, 0, activation="relu")
+        b2 = model.conv2d(x, 8, 3, 3, 1, 1, 1, 1, activation="relu")
+        b3 = model.pool2d(x, 3, 3, 1, 1, 1, 1)
+        t = model.concat([b1, b2, b3], axis=1)
+        t = model.conv2d(t, 16, 3, 3, 2, 2, 1, 1, activation="relu")
+        t = model.flat(t)
+        t = model.dense(t, 8)
+        model.compile(ff.SGDOptimizer(lr=0.1),
+                      ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY, [],
+                      final_tensor=t)
+        model.init_layers(seed=0)
+        rng = np.random.default_rng(0)
+        xd = rng.standard_normal((8, 3, 16, 16)).astype(np.float32)
+        yd = rng.integers(0, 8, (8, 1)).astype(np.int32)
+        return [float(model.train_batch(xd, yd)) for _ in range(steps)]
+
+    np.testing.assert_allclose(train("nchw"), train("nhwc"), rtol=1e-5)
