@@ -127,33 +127,51 @@ def _use_fast_pool() -> bool:
 # position parity turns the dgrad into s*s dense STRIDE-1 convs of the
 # un-dilated gradient with the filter taps of matching parity — the
 # exact same useful FLOPs, zero waste, all MXU-friendly.  The filter
-# gradient keeps XLA's standard path.  NHWC only (the layout the
-# concat-heavy nets resolve to); FF_FAST_DGRAD=0 restores autodiff.
+# gradient keeps XLA's standard path.  Both layouts (`nhwc` static arg;
+# NHWC/HWIO or NCHW/OIHW); FF_FAST_DGRAD=0 restores autodiff.
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
-def _conv_nhwc_fast_dgrad(x, w, stride, padding):
-    """conv_general_dilated NHWC/HWIO with a phase-decomposed dgrad."""
+def _conv_dn(nhwc: bool):
+    return ("NHWC", "HWIO", "NHWC") if nhwc else ("NCHW", "OIHW", "NCHW")
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _conv_fast_dgrad(x, w, stride, padding, nhwc):
+    """conv_general_dilated with a phase-decomposed dgrad."""
     return lax.conv_general_dilated(
         x, w, window_strides=stride,
         padding=[(padding[0], padding[0]), (padding[1], padding[1])],
-        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        dimension_numbers=_conv_dn(nhwc))
 
 
-def _conv_fast_dgrad_fwd(x, w, stride, padding):
-    y = _conv_nhwc_fast_dgrad(x, w, stride, padding)
+def _conv_fast_dgrad_fwd(x, w, stride, padding, nhwc):
+    y = _conv_fast_dgrad(x, w, stride, padding, nhwc)
     return y, (x, w)
 
 
-def _phase_dgrad(dy, w, x_shape, stride, padding):
-    """dx for NHWC conv via parity-phase stride-1 convs of dy."""
-    n, h, wd, cin = x_shape
-    kh, kw, _, cout = w.shape
+def _phase_dgrad(dy, w, x_shape, stride, padding, nhwc):
+    """dx via parity-phase stride-1 convs of dy (both layouts)."""
+    if nhwc:
+        n, h, wd, cin = x_shape
+        kh, kw = w.shape[0], w.shape[1]
+        dh, dw_ = 1, 2  # spatial dims of activations
+        oh, ow = dy.shape[1], dy.shape[2]
+    else:
+        n, cin, h, wd = x_shape
+        kh, kw = w.shape[2], w.shape[3]
+        dh, dw_ = 2, 3
+        oh, ow = dy.shape[2], dy.shape[3]
     sh, sw = stride
     ph, pw = padding
-    oh, ow = dy.shape[1], dy.shape[2]
     zero = jnp.zeros((), dy.dtype)
-    out = jnp.zeros((n, h, wd, cin), dy.dtype)
+
+    def dimtuple(base, vh, vw):
+        full = list(base)
+        full[dh], full[dw_] = vh, vw
+        return tuple(full)
+
+    out = jnp.zeros((n, h, wd, cin) if nhwc else (n, cin, h, wd),
+                    dy.dtype)
     for rh in range(sh):
         for rw in range(sw):
             # taps whose contribution lands on input parity (rh, rw)
@@ -164,9 +182,13 @@ def _phase_dgrad(dy, w, x_shape, stride, padding):
             if not taps_h or not taps_w or hq <= 0 or wq <= 0:
                 continue
             # phase filter: selected taps, spatially flipped, in/out
-            # channels swapped -> HWIO with I=cout, O=cin
-            wp = w[jnp.array(taps_h)][:, jnp.array(taps_w)]
-            wp = jnp.transpose(wp[::-1, ::-1], (0, 1, 3, 2))
+            # channels swapped (HWIO with I=cout / OIHW with O=cin)
+            if nhwc:
+                wp = w[jnp.array(taps_h)][:, jnp.array(taps_w)]
+                wp = jnp.transpose(wp[::-1, ::-1], (0, 1, 3, 2))
+            else:
+                wp = w[:, :, jnp.array(taps_h)][:, :, :, jnp.array(taps_w)]
+                wp = jnp.transpose(wp[:, :, ::-1, ::-1], (1, 0, 2, 3))
             # dx[rh + sh*q] = sum_j dy[q - off_j] * wp_j with integer
             # offsets; realized as a VALID stride-1 conv over padded dy
             offs_h = [(a - rh - ph) // sh for a in taps_h]
@@ -175,40 +197,38 @@ def _phase_dgrad(dy, w, x_shape, stride, padding):
             # conv remainder — negative values crop (lax.pad edge
             # padding may be negative); clamping to 0 would misalign
             # the flipped taps when every offset is negative
-            dyp = lax.pad(dy, zero, (
-                (0, 0, 0),
+            dyp = lax.pad(dy, zero, dimtuple(
+                [(0, 0, 0)] * 4,
                 (max(offs_h), hq - 1 - min(offs_h) - (oh - 1), 0),
-                (max(offs_w), wq - 1 - min(offs_w) - (ow - 1), 0),
-                (0, 0, 0)))
+                (max(offs_w), wq - 1 - min(offs_w) - (ow - 1), 0)))
             dxp = lax.conv_general_dilated(
                 dyp, wp, window_strides=(1, 1), padding=[(0, 0), (0, 0)],
-                dimension_numbers=("NHWC", "HWIO", "NHWC"))
-            assert dxp.shape[1] == hq and dxp.shape[2] == wq, (
+                dimension_numbers=_conv_dn(nhwc))
+            assert (dxp.shape[dh], dxp.shape[dw_]) == (hq, wq), (
                 dxp.shape, hq, wq)
             # interleave onto the (rh::sh, rw::sw) grid via interior-
             # dilated pad (phases are disjoint, so summation interleaves)
-            out = out + lax.pad(dxp, zero, (
-                (0, 0, 0),
+            out = out + lax.pad(dxp, zero, dimtuple(
+                [(0, 0, 0)] * 4,
                 (rh, h - ((hq - 1) * sh + rh) - 1, sh - 1),
-                (rw, wd - ((wq - 1) * sw + rw) - 1, sw - 1),
-                (0, 0, 0)))
+                (rw, wd - ((wq - 1) * sw + rw) - 1, sw - 1)))
     return out
 
 
-def _conv_fast_dgrad_bwd(stride, padding, res, g):
+def _conv_fast_dgrad_bwd(stride, padding, nhwc, res, g):
     x, w = res
-    dx = _phase_dgrad(g, w, x.shape, stride, padding)
+    dx = _phase_dgrad(g, w, x.shape, stride, padding, nhwc)
     # filter grad keeps XLA's standard bwd-filter formulation
     _, w_pullback = jax.vjp(
         lambda ww: lax.conv_general_dilated(
             x, ww, window_strides=stride,
             padding=[(padding[0], padding[0]), (padding[1], padding[1])],
-            dimension_numbers=("NHWC", "HWIO", "NHWC")), w)
+            dimension_numbers=_conv_dn(nhwc)), w)
     (dw,) = w_pullback(g)
     return dx, dw
 
 
-_conv_nhwc_fast_dgrad.defvjp(_conv_fast_dgrad_fwd, _conv_fast_dgrad_bwd)
+_conv_fast_dgrad.defvjp(_conv_fast_dgrad_fwd, _conv_fast_dgrad_bwd)
 
 
 def _use_fast_dgrad() -> bool:
@@ -259,12 +279,12 @@ class Conv2D(Op):
         # no explicit preferred_element_type: the MXU accumulates bf16 convs
         # in f32 natively, and JAX's conv transpose rule rejects mixed
         # operand/accumulator dtypes in the backward pass
-        if (nhwc and self.groups == 1 and max(self.stride) > 1
+        if (self.groups == 1 and max(self.stride) > 1
                 and _use_fast_dgrad()):
             # strided conv: custom VJP replaces the dilated-dgrad
             # lowering with parity-phase stride-1 convs (see
-            # _conv_nhwc_fast_dgrad above)
-            y = _conv_nhwc_fast_dgrad(x, k, self.stride, (ph, pw))
+            # _conv_fast_dgrad above)
+            y = _conv_fast_dgrad(x, k, self.stride, (ph, pw), nhwc)
         else:
             y = lax.conv_general_dilated(
                 x, k, window_strides=self.stride,

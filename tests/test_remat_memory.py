@@ -213,14 +213,15 @@ def test_fast_max_pool_matches_autodiff():
 
 def test_fast_dgrad_matches_autodiff():
     """Phase-decomposed stride-s data gradient (ops/conv.py
-    _conv_nhwc_fast_dgrad) vs jax autodiff, incl. odd extents, 7x7/s2/p3
-    stems and 1x1/s2 projections; the filter grad shares XLA's path so
-    only dx needs the check."""
+    _conv_fast_dgrad) vs jax autodiff in BOTH layouts (NHWC/HWIO and
+    NCHW/OIHW), incl. odd extents, 7x7/s2/p3 stems and 1x1/s2
+    projections; the filter grad shares XLA's path so only dx needs
+    the check."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
-    from flexflow_tpu.ops.conv import _conv_nhwc_fast_dgrad
+    from flexflow_tpu.ops.conv import _conv_dn, _conv_fast_dgrad
 
     rng = np.random.default_rng(0)
     cases = [((2, 16, 16, 3), (3, 3), (2, 2), (1, 1), 8),
@@ -229,28 +230,36 @@ def test_fast_dgrad_matches_autodiff():
              ((2, 16, 16, 4), (1, 1), (2, 2), (0, 0), 8),
              ((2, 15, 15, 4), (3, 3), (2, 2), (0, 0), 8),
              ((2, 12, 12, 4), (3, 3), (3, 1), (1, 1), 8)]
-    for xshape, k, s, p, cout in cases:
-        x = jnp.array(rng.standard_normal(xshape), jnp.float32)
-        w = jnp.array(rng.standard_normal(k + (xshape[3], cout)),
-                      jnp.float32)
+    for nhwc in (True, False):
+        for xshape, k, s, p, cout in cases:
+            cin = xshape[3]
+            if not nhwc:  # move channels to dim 1, weights to OIHW
+                xshape = (xshape[0], cin, xshape[1], xshape[2])
+                wshape = (cout, cin) + k
+            else:
+                wshape = k + (cin, cout)
+            x = jnp.array(rng.standard_normal(xshape), jnp.float32)
+            w = jnp.array(rng.standard_normal(wshape), jnp.float32)
 
-        def ref(x, w, s=s, p=p):
-            return lax.conv_general_dilated(
-                x, w, window_strides=s,
-                padding=[(p[0], p[0]), (p[1], p[1])],
-                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            def ref(x, w, s=s, p=p, nhwc=nhwc):
+                return lax.conv_general_dilated(
+                    x, w, window_strides=s,
+                    padding=[(p[0], p[0]), (p[1], p[1])],
+                    dimension_numbers=_conv_dn(nhwc))
 
-        y0 = ref(x, w)
-        y1 = _conv_nhwc_fast_dgrad(x, w, s, p)
-        assert jnp.allclose(y0, y1)
-        ct = jnp.array(rng.standard_normal(y0.shape), jnp.float32)
-        gx0, gw0 = jax.grad(
-            lambda x, w: jnp.vdot(ref(x, w), ct), argnums=(0, 1))(x, w)
-        gx1, gw1 = jax.grad(
-            lambda x, w, s=s, p=p: jnp.vdot(
-                _conv_nhwc_fast_dgrad(x, w, s, p), ct),
-            argnums=(0, 1))(x, w)
-        scale = float(jnp.abs(gx0).max()) + 1e-6
-        assert float(jnp.abs(gx0 - gx1).max()) / scale < 1e-5, (k, s, p)
-        wscale = float(jnp.abs(gw0).max()) + 1e-6
-        assert float(jnp.abs(gw0 - gw1).max()) / wscale < 1e-5, (k, s, p)
+            y0 = ref(x, w)
+            y1 = _conv_fast_dgrad(x, w, s, p, nhwc)
+            assert jnp.allclose(y0, y1)
+            ct = jnp.array(rng.standard_normal(y0.shape), jnp.float32)
+            gx0, gw0 = jax.grad(
+                lambda x, w: jnp.vdot(ref(x, w), ct), argnums=(0, 1))(x, w)
+            gx1, gw1 = jax.grad(
+                lambda x, w, s=s, p=p, nhwc=nhwc: jnp.vdot(
+                    _conv_fast_dgrad(x, w, s, p, nhwc), ct),
+                argnums=(0, 1))(x, w)
+            scale = float(jnp.abs(gx0).max()) + 1e-6
+            assert float(jnp.abs(gx0 - gx1).max()) / scale < 1e-5, \
+                (k, s, p, nhwc)
+            wscale = float(jnp.abs(gw0).max()) + 1e-6
+            assert float(jnp.abs(gw0 - gw1).max()) / wscale < 1e-5, \
+                (k, s, p, nhwc)
