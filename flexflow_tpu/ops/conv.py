@@ -33,33 +33,60 @@ from .common import apply_activation, cast_compute
 # Fast max-pool: XLA lowers the autodiff backward of reduce_window(max) to
 # SelectAndScatter, which serializes badly on TPU — the round-5 on-chip
 # attribution (artifacts/INCEPTION_MFU.md) charged 27% of Inception's step
-# to pool2d, with a single stem pool's backward costing 2.9 ms.  This
-# custom_vjp keeps the reduce_window forward but computes the backward as
-# k*k shifted equality-masks (first-match, cuDNN tie semantics) scattered
-# through interior-dilated pads — all elementwise/VPU work XLA fuses.
-# FF_FAST_POOL=0 restores the autodiff path (chip A/B knob).
+# to pool2d, with a single stem pool's backward costing 2.9 ms and its
+# forward 3-6x the bandwidth roofline.  This custom_vjp computes BOTH
+# directions from k*k strided window slices: forward = elementwise max
+# tree, backward = shifted equality-masks (first-match, cuDNN tie
+# semantics) scattered through interior-dilated pads — all
+# elementwise/VPU work XLA fuses.  FF_FAST_POOL=0 restores the
+# reduce_window + autodiff path (chip A/B knob).
 # ---------------------------------------------------------------------------
 
-def _pool_dims(x_ndim, spatial):
-    """Per-dim (window, stride, pad) builders for the two layouts."""
-    def expand(vals, default):
-        full = [default] * x_ndim
-        for d, v in zip(spatial, vals):
-            full[d] = v
-        return tuple(full)
-    return expand
+def _dimtuple(base, dh, dw, vh, vw):
+    """``base`` with positions ``dh``/``dw`` replaced — the one spot the
+    fwd and bwd window arithmetic share."""
+    full = list(base)
+    full[dh], full[dw] = vh, vw
+    return tuple(full)
+
+
+def _window_slices(xp, kernel, stride, out_hw, spatial):
+    """Yield ((i, j), x_ij) for every window offset: x_ij[o] =
+    xp[o*s + (i, j)] over the ``spatial`` dims of padded ``xp``.  The
+    equality-mask backward is only correct if it compares the EXACT
+    slices the forward maxed over, so both directions call this."""
+    (kh, kw), (sh, sw), (oh, ow) = kernel, stride, out_hw
+    dh, dw = spatial
+    for i in range(kh):
+        for j in range(kw):
+            yield (i, j), lax.slice(
+                xp, _dimtuple([0] * xp.ndim, dh, dw, i, j),
+                _dimtuple(xp.shape, dh, dw, i + (oh - 1) * sh + 1,
+                          j + (ow - 1) * sw + 1),
+                _dimtuple([1] * xp.ndim, dh, dw, sh, sw))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
 def _fast_max_pool(x, kernel, stride, padding, spatial):
     """Max pool over the ``spatial`` dims (e.g. (1, 2) for NHWC,
-    (2, 3) for NCHW) of a 4-D array."""
-    expand = _pool_dims(x.ndim, spatial)
-    init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
-        else jnp.iinfo(x.dtype).min
-    return lax.reduce_window(
-        x, init, lax.max, expand(kernel, 1), expand(stride, 1),
-        tuple((p, p) for p in expand(padding, 0)))
+    (2, 3) for NCHW) of a 4-D array.  Forward is an elementwise max
+    over the k*k strided window slices — XLA fuses the max tree into
+    one pass, where generic ``reduce_window`` measured 3-6x the
+    bandwidth roofline on chip (stem pool fwd 1.2 ms vs ~0.2,
+    artifacts/r5/bottleneck_inc.log)."""
+    (kh, kw), (sh, sw), (ph, pw) = kernel, stride, padding
+    dh, dw = spatial
+    h, w = x.shape[dh], x.shape[dw]
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    neg = jnp.array(-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                    else jnp.iinfo(x.dtype).min, x.dtype)
+    xp = lax.pad(x, neg, _dimtuple([(0, 0, 0)] * x.ndim, dh, dw,
+                                   (ph, ph, 0), (pw, pw, 0)))
+    y = None
+    for _, x_ij in _window_slices(xp, kernel, stride, (oh, ow), spatial):
+        y = x_ij if y is None else jnp.maximum(y, x_ij)
+    return y
 
 
 def _fast_max_pool_fwd(x, kernel, stride, padding, spatial):
@@ -74,41 +101,29 @@ def _fast_max_pool_bwd(kernel, stride, padding, spatial, res, g):
     h, w = x.shape[dh], x.shape[dw]
     oh, ow = y.shape[dh], y.shape[dw]
     hp, wp = h + 2 * ph, w + 2 * pw
-
-    def dimtuple(base, vals_h, vals_w):
-        full = list(base)
-        full[dh], full[dw] = vals_h, vals_w
-        return tuple(full)
-
     neg = jnp.array(-jnp.inf, x.dtype)
-    xp = lax.pad(x, neg, dimtuple([(0, 0, 0)] * x.ndim,
-                                  (ph, ph, 0), (pw, pw, 0)))
-    grad_p = jnp.zeros(dimtuple(x.shape, hp, wp), g.dtype)
+    xp = lax.pad(x, neg, _dimtuple([(0, 0, 0)] * x.ndim, dh, dw,
+                                   (ph, ph, 0), (pw, pw, 0)))
+    grad_p = jnp.zeros(_dimtuple(x.shape, dh, dw, hp, wp), g.dtype)
     claimed = jnp.zeros(y.shape, jnp.bool_)
     zero = jnp.zeros((), g.dtype)
-    for i in range(kh):
-        for j in range(kw):
-            # x value each window sees at offset (i, j):
-            # x_ij[o] = xp[o*s + (i, j)]
-            x_ij = lax.slice(
-                xp, dimtuple([0] * x.ndim, i, j),
-                dimtuple(xp.shape, i + (oh - 1) * sh + 1,
-                         j + (ow - 1) * sw + 1),
-                dimtuple([1] * x.ndim, sh, sw))
-            m = jnp.logical_and(x_ij == y, jnp.logical_not(claimed))
-            claimed = jnp.logical_or(claimed, m)
-            contrib = jnp.where(m, g, zero)
-            # scatter contrib[o] into grad_p[o*s + (i, j)]: interior
-            # dilation by s-1 places outputs on the stride grid, low
-            # padding shifts by the offset (first-match mask = cuDNN
-            # tie semantics)
-            grad_p = grad_p + lax.pad(
-                contrib, zero,
-                dimtuple([(0, 0, 0)] * x.ndim,
-                         (i, hp - ((oh - 1) * sh + 1) - i, sh - 1),
-                         (j, wp - ((ow - 1) * sw + 1) - j, sw - 1)))
-    return (lax.slice(grad_p, dimtuple([0] * x.ndim, ph, pw),
-                      dimtuple(grad_p.shape, ph + h, pw + w)),)
+    # the same slices the forward maxed over (bit-exact tie behavior)
+    for (i, j), x_ij in _window_slices(xp, kernel, stride, (oh, ow),
+                                       spatial):
+        m = jnp.logical_and(x_ij == y, jnp.logical_not(claimed))
+        claimed = jnp.logical_or(claimed, m)
+        contrib = jnp.where(m, g, zero)
+        # scatter contrib[o] into grad_p[o*s + (i, j)]: interior
+        # dilation by s-1 places outputs on the stride grid, low
+        # padding shifts by the offset (first-match mask = cuDNN
+        # tie semantics)
+        grad_p = grad_p + lax.pad(
+            contrib, zero,
+            _dimtuple([(0, 0, 0)] * x.ndim, dh, dw,
+                      (i, hp - ((oh - 1) * sh + 1) - i, sh - 1),
+                      (j, wp - ((ow - 1) * sw + 1) - j, sw - 1)))
+    return (lax.slice(grad_p, _dimtuple([0] * x.ndim, dh, dw, ph, pw),
+                      _dimtuple(grad_p.shape, dh, dw, ph + h, pw + w)),)
 
 
 _fast_max_pool.defvjp(_fast_max_pool_fwd, _fast_max_pool_bwd)
