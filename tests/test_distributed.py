@@ -88,7 +88,7 @@ def _single_process_reference(shape):
     env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
     env.pop("XLA_FLAGS", None)
     out = subprocess.run([sys.executable, "-c", code], env=env,
-                         capture_output=True, text=True, timeout=420)
+                         capture_output=True, text=True, timeout=900)
     assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
     for line in out.stdout.splitlines():
         if line.startswith("REF_LOSS "):
@@ -108,7 +108,7 @@ def test_two_process_mesh_trains_and_resumes(tmp_path):
 def test_four_process_pipeline_mesh_trains_and_resumes(tmp_path):
     """dp2 x tp2 x pp2 over 4 processes x 2 devices (VERDICT r4 ask #9):
     pipeline stages and TP groups both straddle process boundaries."""
-    losses = _run_workers(4, 2, "dp2tp2pp2", tmp_path, timeout=600)
+    losses = _run_workers(4, 2, "dp2tp2pp2", tmp_path, timeout=1200)
     loss = _check(losses)
     ref_loss = _single_process_reference("dp2tp2pp2")
     assert abs(ref_loss - loss) < 1e-4, (ref_loss, loss)
