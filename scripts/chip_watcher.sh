@@ -1,8 +1,11 @@
 #!/bin/bash
-# Persistent chip-window watcher.  Probes every 120s; when the tunnel
-# is up, runs pending steps from scripts/chip_queue.txt (re-read every
-# pass, so the queue is editable while this runs; steps mark .done on a
-# successful, result-bearing run).  Never edit THIS file while running.
+# Persistent chip-window watcher, v2.  Probes every 120s; when the
+# tunnel is up, runs pending steps from scripts/chip_queue.txt (re-read
+# every pass, so the queue is editable while this runs; steps mark
+# .done on a successful, result-bearing run).  v2: the probe gates
+# EVERY step, not just the pass — a tunnel that dies mid-window costs
+# one step's timeout, not the whole queue's.  Never edit THIS file
+# while it is running.
 cd /root/repo
 export FF_BENCH_PROBE_ATTEMPTS=1 FF_BENCH_PROBE_TIMEOUT=60 FF_BENCH_MAX_WAIT=70
 R=artifacts/r5
@@ -13,11 +16,17 @@ assert jax.devices()[0].platform == "tpu"
 PY
 }
 run_pending() {
+  # Snapshot the queue so a mid-pass edit can't disturb the stream read.
+  cp scripts/chip_queue.txt "$R/.queue_pass"
   while IFS='|' read -r name t cmd; do
     name=$(echo $name); t=$(echo $t); cmd=$(echo $cmd)
     [ -z "$name" ] && continue
     case "$name" in \#*) continue;; esac
     [ -f "$R/$name.done" ] && continue
+    if ! probe_ok; then
+      echo "### probe failed before $name $(date +%T); pausing pass" >> $R/drain.log
+      return 1
+    fi
     echo "=== $name : $cmd : start $(date +%T) ===" >> $R/drain.log
     timeout "$t" bash -c "$cmd" < /dev/null > "$R/$name.log" 2>&1
     rc=$?
@@ -26,7 +35,7 @@ run_pending() {
       touch "$R/$name.done"
     fi
     grep -q "backend unavailable" "$R/$name.log" 2>/dev/null && return 1
-  done < scripts/chip_queue.txt
+  done < "$R/.queue_pass"
   return 0
 }
 while true; do
