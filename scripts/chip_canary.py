@@ -1,0 +1,47 @@
+"""Tunnel-health canary: separates tunnel latency from kernel speed.
+
+Times (a) one fenced round-trip on a trivial op and (b) a chain of 50
+tiny matmuls with a single end fence.  On a healthy tunnel the chained
+per-op overhead is sub-millisecond; during tunnel degradation both
+numbers balloon.  Run alongside bench steps so each window's
+measurements carry a health stamp (mirrors the reference's practice of
+printing machine state next to throughput, e.g. its ELAPSED lines).
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    dev = jax.devices()[0]
+    x = jnp.ones((256, 256), jnp.bfloat16)
+
+    @jax.jit
+    def mm(a):
+        return a @ a
+
+    # compile + one fenced round trip
+    y = mm(x)
+    float(jnp.sum(y))
+    t0 = time.perf_counter()
+    float(jnp.sum(mm(x)))
+    rt = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    y = x
+    for _ in range(50):
+        y = mm(y)
+    float(jnp.sum(y))
+    chained = (time.perf_counter() - t0) / 50
+
+    print({
+        "canary_roundtrip_ms": round(rt * 1e3, 2),
+        "canary_chained_op_ms": round(chained * 1e3, 3),
+        "device": str(dev.device_kind),
+        "time": time.strftime("%H:%M:%S"),
+    })
+
+
+if __name__ == "__main__":
+    main()
