@@ -912,6 +912,21 @@ class FFModel:
         for k, sh in self._host_shardings.items():
             self._params[k] = jax.device_put(self._params[k], sh)
 
+    def warmup_compile(self, *arrays) -> None:
+        """Compile the fused train step for ``arrays`` WITHOUT executing it.
+
+        Two uses: (a) pay the one-time XLA compile before fenced timing
+        (the reference's warm-up iterations before its ELAPSED fence,
+        alexnet.cc:102-118); (b) in multi-controller runs, compile on
+        every process BEFORE the first execution — the backend's
+        collective-context rendezvous at first execute has a short
+        deadline, and per-process compile skew can exceed it (pair with
+        ``parallel.distributed.coordination_barrier``).
+        """
+        batch = tuple(self._shard_batch(arrays))
+        self._train_step.lower(self._params, self._opt_state, batch,
+                               self._step).compile()
+
     def train_batch(self, *arrays) -> float:
         """One fused train step; returns loss."""
         batch = tuple(self._shard_batch(arrays))

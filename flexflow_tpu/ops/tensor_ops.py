@@ -15,6 +15,7 @@ the Softmax op itself is a true softmax with a true autodiff backward.
 
 from __future__ import annotations
 
+import os
 from typing import List
 
 import jax
@@ -96,7 +97,8 @@ class Concat(Op):
         # backwards 3-4x their roofline to exactly these relayouts
         # (artifacts/INCEPTION_MFU.md)
         if (getattr(ctx, "conv_layout", "nchw") == "nhwc"
-                and self.axis == 1 and xs[0].ndim == 4):
+                and self.axis == 1 and xs[0].ndim == 4
+                and os.environ.get("FF_FAST_CONCAT", "1") != "0"):
             xs = [jnp.transpose(x, (0, 2, 3, 1)) for x in xs]
             y = jnp.concatenate(xs, axis=3)
             return [jnp.transpose(y, (0, 3, 1, 2))]

@@ -58,6 +58,45 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     return True
 
 
+def coordination_barrier(name: str = "ff_barrier",
+                         timeout_s: int = 900) -> None:
+    """Host-level barrier through the coordination service (single-process
+    no-op).  Unlike a device collective this is usable BEFORE the first
+    program executes: the CPU/TPU collective context is set up lazily at
+    first execution with a short (~30 s) rendezvous deadline, so when
+    per-process compile times are skewed (cold caches, contended hosts)
+    the fast processes must wait HERE, not in the rendezvous.  The
+    reference reaches the same global quiescence with
+    ``runtime->issue_execution_fence`` between phases."""
+    if jax.process_count() <= 1:
+        return
+    from jax._src import distributed
+
+    client = getattr(distributed.global_state, "client", None)
+    if client is not None:
+        client.wait_at_barrier(name, timeout_in_ms=timeout_s * 1000)
+
+
+def finalize_distributed() -> None:
+    """Tear down the multi-host runtime (single-process no-op).
+
+    Synchronizes every process with a device-level barrier BEFORE asking
+    the coordination service to shut down: the service's shutdown
+    barrier has a short (~30 s) deadline, and on a contended host a
+    straggler — still flushing checkpoints or garbage-collecting — can
+    miss it, poisoning every other process with a fatal
+    ``Shutdown barrier has failed``.  The sync has no such deadline, so
+    all processes arrive at the shutdown barrier together.  Mirrors the
+    reference's explicit runtime teardown at the end of top_level_task.
+    """
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("flexflow_tpu_finalize")
+    jax.distributed.shutdown()
+
+
 def process_info() -> dict:
     return {
         "process_index": jax.process_index(),

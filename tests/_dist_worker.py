@@ -92,6 +92,15 @@ def main():
     model, feed = build_model(shape)
     xd, yd = make_batch(feed)
 
+    # compile everywhere BEFORE anyone executes: the first execution's
+    # gloo context rendezvous has a ~30 s deadline, far less than the
+    # compile skew between contended processes (coordination_barrier
+    # docstring has the full story)
+    from flexflow_tpu.parallel.distributed import coordination_barrier
+
+    model.warmup_compile(xd, yd)
+    coordination_barrier("ff_worker_compiled")
+
     for _ in range(3):
         loss = float(model.train_batch(xd, yd))
 
@@ -110,6 +119,10 @@ def main():
         f.write(f"{loss} {loss_after_save} {loss_after_restore}\n")
     print(f"proc {pid}: loss={loss:.6f} resume_delta="
           f"{abs(loss_after_save - loss_after_restore):.2e}")
+
+    from flexflow_tpu.parallel.distributed import finalize_distributed
+
+    finalize_distributed()  # sync first: see the docstring (30 s barrier)
 
 
 if __name__ == "__main__":

@@ -48,9 +48,27 @@ def _run_workers(nprocs, dev_per_proc, shape, tmp_path, timeout):
             text=True)
         for i in range(nprocs)
     ]
-    for p in procs:
-        out, _ = p.communicate(timeout=timeout)
-        assert p.returncode == 0, out[-3000:]
+    # Gather EVERY worker's output before asserting: when a straggler
+    # crashes, the coordinator (proc 0) dies of the propagated barrier
+    # error first, and asserting in order would report proc 0's noise
+    # instead of the root-cause traceback.
+    outs = [p.communicate(timeout=timeout)[0] for p in procs]
+    failed = [i for i, p in enumerate(procs) if p.returncode != 0]
+    if failed:
+        # Prefer the failing proc whose traceback is NOT coordination-
+        # service noise: the coordinator dies of the PROPAGATED barrier
+        # error, and reporting it would hide the straggler's root cause.
+        def propagated(o):
+            return ("Shutdown barrier" in o or "coordination service"
+                    in o.lower())
+
+        culprit = next(
+            (i for i in failed if "Traceback" in outs[i]
+             and not propagated(outs[i])),
+            next((i for i in failed if "Traceback" in outs[i]), failed[0]))
+        raise AssertionError(
+            f"proc {culprit} rc={procs[culprit].returncode}:\n"
+            + outs[culprit][-3000:])
     losses = []
     for i in range(nprocs):
         with open(tmp_path / f"loss_{i}.txt") as f:
