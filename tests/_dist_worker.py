@@ -99,7 +99,10 @@ def main():
     from flexflow_tpu.parallel.distributed import coordination_barrier
 
     model.warmup_compile(xd, yd)
-    coordination_barrier("ff_worker_compiled")
+    # barrier deadline below the launcher's subprocess timeout, so a
+    # stuck straggler surfaces as a captured barrier error, never as a
+    # bare TimeoutExpired with no worker output
+    coordination_barrier("ff_worker_compiled", timeout_s=240)
 
     for _ in range(3):
         loss = float(model.train_batch(xd, yd))

@@ -52,7 +52,19 @@ def _run_workers(nprocs, dev_per_proc, shape, tmp_path, timeout):
     # crashes, the coordinator (proc 0) dies of the propagated barrier
     # error first, and asserting in order would report proc 0's noise
     # instead of the root-cause traceback.
-    outs = [p.communicate(timeout=timeout)[0] for p in procs]
+    try:
+        outs = [p.communicate(timeout=timeout)[0] for p in procs]
+    except subprocess.TimeoutExpired:
+        # kill + reap EVERY worker (abandoned ones would squat on the
+        # coordinator port and the CPU for up to the barrier deadline),
+        # then report whatever output the stuck run produced
+        for p in procs:
+            p.kill()
+        outs = [p.communicate()[0] for p in procs]
+        raise AssertionError(
+            "worker timeout; outputs:\n"
+            + "\n".join(f"--- proc {i} rc={p.returncode}:\n{o[-1500:]}"
+                        for i, (p, o) in enumerate(zip(procs, outs))))
     failed = [i for i, p in enumerate(procs) if p.returncode != 0]
     if failed:
         # Prefer the failing proc whose traceback is NOT coordination-

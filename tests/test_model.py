@@ -158,3 +158,37 @@ def test_mse_regression():
     yd = (xd @ np.array([1.0, -2.0, 0.5, 3.0], np.float32))[:, None]
     losses = [float(model.train_batch(xd, yd)) for _ in range(60)]
     assert losses[-1] < losses[0] * 0.2
+
+
+def test_warmup_compile_is_pure_and_step_count_unchanged():
+    """warmup_compile pays the XLA compile without executing a step:
+    params, optimizer state and the step counter must be untouched, and
+    the first real train_batch must produce the same loss as a model
+    that never warmed up."""
+    model, logits = small_mlp()
+    model.compile(ff.SGDOptimizer(lr=0.1),
+                  ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [ff.METRICS_ACCURACY], final_tensor=logits)
+    model.init_layers(seed=0)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((16, 8), dtype=np.float32)
+    y = rng.integers(0, 4, (16, 1)).astype(np.int32)
+
+    ref, ref_logits = small_mlp()
+    ref.compile(ff.SGDOptimizer(lr=0.1),
+                ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                [ff.METRICS_ACCURACY], final_tensor=ref_logits)
+    ref.init_layers(seed=0)
+
+    before = model._step
+    model.warmup_compile(x, y)
+    assert model._step == before
+    assert float(model.train_batch(x, y)) == float(ref.train_batch(x, y))
+
+
+def test_distributed_helpers_are_single_process_noops():
+    from flexflow_tpu.parallel.distributed import (coordination_barrier,
+                                                   finalize_distributed)
+
+    coordination_barrier("noop")  # must not raise without a coordinator
+    finalize_distributed()
