@@ -17,7 +17,6 @@ rects.
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional
 
 import jax
@@ -26,6 +25,7 @@ from jax import lax
 
 from ..initializers import GlorotUniform, ZeroInitializer
 from ..op import Op, OpContext, OpType
+from ..tuned import flag_enabled
 from .common import apply_activation, cast_compute
 
 
@@ -130,7 +130,7 @@ _fast_max_pool.defvjp(_fast_max_pool_fwd, _fast_max_pool_bwd)
 
 
 def _use_fast_pool() -> bool:
-    return os.environ.get("FF_FAST_POOL", "1") != "0"
+    return flag_enabled("FF_FAST_POOL", "fast_pool")
 
 
 # ---------------------------------------------------------------------------
@@ -247,7 +247,7 @@ _conv_fast_dgrad.defvjp(_conv_fast_dgrad_fwd, _conv_fast_dgrad_bwd)
 
 
 def _use_fast_dgrad() -> bool:
-    return os.environ.get("FF_FAST_DGRAD", "1") != "0"
+    return flag_enabled("FF_FAST_DGRAD", "fast_dgrad")
 
 
 class Conv2D(Op):

@@ -15,13 +15,13 @@ the Softmax op itself is a true softmax with a true autodiff backward.
 
 from __future__ import annotations
 
-import os
 from typing import List
 
 import jax
 import jax.numpy as jnp
 
 from ..op import Op, OpContext, OpType
+from ..tuned import flag_enabled
 from .common import cast_compute
 
 
@@ -98,7 +98,7 @@ class Concat(Op):
         # (artifacts/INCEPTION_MFU.md)
         if (getattr(ctx, "conv_layout", "nchw") == "nhwc"
                 and self.axis == 1 and xs[0].ndim == 4
-                and os.environ.get("FF_FAST_CONCAT", "1") != "0"):
+                and flag_enabled("FF_FAST_CONCAT", "fast_concat")):
             xs = [jnp.transpose(x, (0, 2, 3, 1)) for x in xs]
             y = jnp.concatenate(xs, axis=3)
             return [jnp.transpose(y, (0, 3, 1, 2))]

@@ -1,0 +1,115 @@
+"""Turn the on-chip kernel A/B logs into committed tuned defaults.
+
+Reads the inception arms the chip queue produces in ``artifacts/r5``:
+
+    incep_fast3/4   pool+dgrad+concat ON   (NHWC)
+    incep_noconcat  pool+dgrad ON, concat OFF
+    incep_ctrl2     all OFF                (NHWC)
+
+and writes ``flexflow_tpu/tuned_defaults.json`` (consumed by
+``flexflow_tpu.tuned.flag_enabled``) for the device kind the benches ran
+on.  Decision rules (each needs BOTH its arms, measured within the same
+tunnel window — mtimes within 45 min — because cross-window absolute
+times aren't comparable when the tunnel degrades):
+
+    fast_pool+fast_dgrad  ON  iff  ms(noconcat) < ms(ctrl2)
+    fast_concat           ON  iff  ms(best fast) < ms(noconcat)
+
+With only the all-on and all-off arms available, all three flags are
+decided together from that single comparison.  Arms that are missing or
+stale leave their flags undecided (built-in defaults stay in force).
+
+Mirrors the reference's measure-then-pick algorithm selection
+(src/ops/conv_2d.cu:864-922) at the lowering level.
+"""
+
+import json
+import os
+import sys
+import time
+
+R = os.path.join(os.path.dirname(__file__), "..", "artifacts", "r5")
+OUT = os.path.join(os.path.dirname(__file__), "..", "flexflow_tpu",
+                   "tuned_defaults.json")
+WINDOW_S = 45 * 60
+
+
+def read_arm(name):
+    """(ms_per_step, mtime) from the newest result row of an arm log."""
+    path = os.path.join(R, f"{name}.log")
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return None
+    ms = None
+    for line in lines:
+        if '"ms_per_step"' not in line:
+            continue
+        try:  # tolerate interleaved/truncated writes on a crashed run
+            row = json.loads(line)
+            ms = float(row["ms_per_step"])
+        except (ValueError, KeyError, TypeError):
+            continue
+    if ms is None:
+        return None
+    return ms, os.path.getmtime(path)
+
+
+def main():
+    arms = {n: read_arm(n) for n in
+            ("incep_fast3", "incep_fast4", "incep_ctrl2", "incep_noconcat")}
+    present = {n: v for n, v in arms.items() if v}
+    print({n: (v[0] if v else None) for n, v in arms.items()})
+    if not present:
+        print("no measured arms; leaving built-in defaults")
+        return 0
+    newest = max(mt for _, mt in present.values())
+    fresh = {n: ms for n, (ms, mt) in present.items()
+             if newest - mt < WINDOW_S}
+    stale = sorted(set(present) - set(fresh))
+    if stale:
+        print(f"stale arms excluded (different window): {stale}")
+
+    fast = min((fresh[n] for n in ("incep_fast3", "incep_fast4")
+                if n in fresh), default=None)
+    ctrl = fresh.get("incep_ctrl2")
+    noconcat = fresh.get("incep_noconcat")
+
+    flags = {}
+    if noconcat is not None and ctrl is not None:
+        flags["fast_pool"] = flags["fast_dgrad"] = noconcat < ctrl
+    if noconcat is not None and fast is not None:
+        flags["fast_concat"] = fast < noconcat
+    if not flags and fast is not None and ctrl is not None:
+        on = fast < ctrl
+        flags = {"fast_pool": on, "fast_dgrad": on, "fast_concat": on}
+    if not flags:
+        print("not enough same-window arms to decide; leaving defaults")
+        return 0
+
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    try:
+        with open(OUT) as f:
+            table = json.load(f)
+    except (OSError, ValueError):
+        table = {}
+    for flag, value in flags.items():
+        table.setdefault(flag, {})[kind] = bool(value)
+    table.setdefault("_meta", {})[kind] = {
+        "decided_utc": time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime()),
+        "arms_ms": {n: fresh[n] for n in sorted(fresh)},
+    }
+    with open(OUT, "w") as f:
+        json.dump(table, f, indent=2, sort_keys=True)
+        f.write("\n")
+    decided = {k: v[kind] for k, v in table.items()
+               if k != "_meta" and kind in v}
+    print(f"tuned_defaults[{kind}] = {decided}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

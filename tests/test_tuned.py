@@ -1,0 +1,89 @@
+"""Measured kernel-path defaults (flexflow_tpu/tuned.py) resolution order."""
+
+import json
+
+import flexflow_tpu.tuned as tuned
+
+
+def _fresh(monkeypatch, tmp_path, table):
+    path = tmp_path / "tuned_defaults.json"
+    path.write_text(json.dumps(table))
+    monkeypatch.setattr(tuned, "_TUNED_PATH", str(path))
+    tuned._tuned_table.cache_clear()
+    tuned._device_kind.cache_clear()
+
+
+def test_env_wins_over_table(monkeypatch, tmp_path):
+    _fresh(monkeypatch, tmp_path,
+           {"fast_pool": {tuned._device_kind(): True}})
+    monkeypatch.setenv("FF_FAST_POOL", "0")
+    assert tuned.flag_enabled("FF_FAST_POOL", "fast_pool") is False
+
+
+def test_table_entry_for_device_kind(monkeypatch, tmp_path):
+    kind = tuned._device_kind()
+    _fresh(monkeypatch, tmp_path, {"fast_pool": {kind: False}})
+    monkeypatch.delenv("FF_FAST_POOL", raising=False)
+    assert tuned.flag_enabled("FF_FAST_POOL", "fast_pool") is False
+    # other device kinds in the table don't apply
+    _fresh(monkeypatch, tmp_path, {"fast_pool": {kind + "-other": False}})
+    assert tuned.flag_enabled("FF_FAST_POOL", "fast_pool") is True
+
+
+def test_default_when_table_absent(monkeypatch, tmp_path):
+    _fresh(monkeypatch, tmp_path, {})
+    monkeypatch.delenv("FF_FAST_POOL", raising=False)
+    assert tuned.flag_enabled("FF_FAST_POOL", "fast_pool") is True
+    assert tuned.flag_enabled("FF_FAST_POOL", "fast_pool",
+                              default=False) is False
+
+
+def test_decide_script_no_arms(tmp_path, monkeypatch):
+    """With no measured arm logs the decision script leaves defaults."""
+    import scripts.decide_fast_kernels as dk
+
+    monkeypatch.setattr(dk, "R", str(tmp_path))
+    monkeypatch.setattr(dk, "OUT", str(tmp_path / "out.json"))
+    assert dk.main() == 0
+    assert not (tmp_path / "out.json").exists()
+
+
+def test_decide_script_same_window_arms(tmp_path, monkeypatch):
+    """fast vs control arms in one window decide all three flags."""
+    import scripts.decide_fast_kernels as dk
+
+    row = '{"metric": "m", "ms_per_step": %s, "unit": "x"}\n'
+    (tmp_path / "incep_fast3.log").write_text(row % 99.0)
+    (tmp_path / "incep_ctrl2.log").write_text(row % 55.0)
+    monkeypatch.setattr(dk, "R", str(tmp_path))
+    monkeypatch.setattr(dk, "OUT", str(tmp_path / "out.json"))
+    assert dk.main() == 0
+    table = json.loads((tmp_path / "out.json").read_text())
+    kind = tuned._device_kind()
+    assert table["fast_pool"][kind] is False
+    assert table["fast_dgrad"][kind] is False
+    assert table["fast_concat"][kind] is False
+
+    # and the reverse outcome when fast wins, plus the 3-arm split:
+    (tmp_path / "incep_noconcat.log").write_text(row % 50.0)
+    (tmp_path / "incep_fast4.log").write_text(row % 47.0)
+    assert dk.main() == 0
+    table = json.loads((tmp_path / "out.json").read_text())
+    assert table["fast_pool"][kind] is True     # noconcat 50 < ctrl 55
+    assert table["fast_concat"][kind] is True   # fast 47 < noconcat 50
+
+
+def test_decide_script_concat_without_control(tmp_path, monkeypatch):
+    """fast vs noconcat alone decides fast_concat (ctrl2 arm missing)."""
+    import scripts.decide_fast_kernels as dk
+
+    row = '{"metric": "m", "ms_per_step": %s, "unit": "x"}\n'
+    (tmp_path / "incep_fast3.log").write_text(row % 47.0)
+    (tmp_path / "incep_noconcat.log").write_text(row % 50.0)
+    monkeypatch.setattr(dk, "R", str(tmp_path))
+    monkeypatch.setattr(dk, "OUT", str(tmp_path / "out.json"))
+    assert dk.main() == 0
+    table = json.loads((tmp_path / "out.json").read_text())
+    kind = tuned._device_kind()
+    assert table["fast_concat"][kind] is True
+    assert "fast_pool" not in table  # pool/dgrad stay undecided
