@@ -1,0 +1,134 @@
+"""Isolated on-chip A/B of the round-5 kernel lowerings.
+
+Times each alternative lowering against XLA's stock path on the exact
+Inception-stem shapes the round-5 attribution charged
+(artifacts/INCEPTION_MFU.md): max-pool backward (SelectAndScatter vs
+the equality-mask VJP), stride-2 conv dgrad (dilated-grad conv vs the
+parity-phase decomposition), and the NHWC channel concat boundary.
+A full-model bench folds tunnel latency, input pipeline and every other
+op into one number; this isolates the kernels, completes inside ~2 min
+of chip time, and prints one JSON line per pair so a short window still
+yields a decisive per-kernel verdict.  Timing uses the same fenced
+min-of-repeats slope scheme as bench.py.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+B = int(os.environ.get("FF_MB_BATCH", "128"))
+ITERS = int(os.environ.get("FF_MB_ITERS", "30"))
+REPEATS = int(os.environ.get("FF_MB_REPEATS", "3"))
+
+import jax
+
+if os.environ.get("FF_MB_FORCE_CPU"):  # smoke-test path: the axon PJRT
+    # plugin overrides JAX_PLATFORMS, so force CPU through jax.config
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def timed(fn, *args, iters=None, repeats=None):
+    """min-over-repeats seconds per execution.  Dispatches ``iters``
+    copies (they serialize on the device stream) and fences once on the
+    last output; min over repeats rejects tunnel hiccups."""
+    iters = iters or ITERS
+    repeats = repeats or REPEATS
+    fn = jax.jit(fn)
+    out = fn(*args)
+    float(jnp.sum(out.astype(jnp.float32)))  # compile + fence
+
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        float(jnp.sum(out.astype(jnp.float32)))
+        best = min(best, time.perf_counter() - t0)
+    return best / iters
+
+
+def row(name, stock_s, fast_s):
+    print(json.dumps({
+        "metric": f"microbench_{name}", "value": round(stock_s / fast_s, 3),
+        "unit": "stock/fast speedup", "vs_baseline": None,
+        "stock_ms": round(stock_s * 1e3, 3),
+        "fast_ms": round(fast_s * 1e3, 3)}), flush=True)
+
+
+def pool_pair():
+    """Stem max-pool 3x3 s2 bwd: b128 NHWC 147x147x64 (bf16)."""
+    from flexflow_tpu.ops.conv import _fast_max_pool
+
+    x = jnp.ones((B, 147, 147, 64), jnp.bfloat16)
+
+    def stock(v):
+        y = lax.reduce_window(v, -jnp.inf, lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1), "VALID")
+        return jax.grad(lambda u: jnp.sum(
+            lax.reduce_window(u, -jnp.inf, lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1), "VALID").astype(jnp.float32)))(v)
+
+    def fast(v):
+        return jax.grad(lambda u: jnp.sum(_fast_max_pool(
+            u, (3, 3), (2, 2), (0, 0), (1, 2)).astype(jnp.float32)))(v)
+
+    row("pool_bwd_stem", timed(stock, x), timed(fast, x))
+
+
+def dgrad_pair():
+    """Stem conv 3x3 s2 dgrad: b128 NHWC 149x149x32 <- 147x147x32."""
+    from flexflow_tpu.ops.conv import _conv_dn, _phase_dgrad
+
+    dy = jnp.ones((B, 74, 74, 32), jnp.bfloat16)
+    w = jnp.ones((3, 3, 32, 32), jnp.bfloat16)
+    xshape = (B, 149, 149, 32)
+
+    def stock(g):
+        # XLA's dgrad formulation: conv of the interior-dilated grad
+        # with the spatially-flipped, io-swapped filter
+        return lax.conv_general_dilated(
+            g, jnp.transpose(w[::-1, ::-1], (0, 1, 3, 2)),
+            window_strides=(1, 1), padding=[(2, 2), (2, 2)],
+            lhs_dilation=(2, 2), dimension_numbers=_conv_dn(True))
+
+    def fast(g):
+        return _phase_dgrad(g, w, xshape, (2, 2), (0, 0), True)
+
+    row("dgrad_s2_stem", timed(stock, dy), timed(fast, dy))
+
+
+def concat_pair():
+    """Channel concat between NHWC-internal convs: stock = concat in
+    NCHW (boundary transposes), fast = lane-axis concat."""
+    xs = [jnp.ones((B, 64, 35, 35), jnp.bfloat16) for _ in range(4)]
+
+    def stock(*vs):
+        return jnp.concatenate(vs, axis=1)
+
+    def fast(*vs):
+        t = [jnp.transpose(v, (0, 2, 3, 1)) for v in vs]
+        return jnp.transpose(jnp.concatenate(t, axis=3), (0, 3, 1, 2))
+
+    row("concat_lane", timed(stock, *xs), timed(fast, *xs))
+
+
+def main():
+    dev = jax.devices()[0]
+    print(json.dumps({"metric": "microbench_device",
+                      "value": 1, "unit": str(dev.device_kind),
+                      "vs_baseline": None}), flush=True)
+    pool_pair()
+    dgrad_pair()
+    concat_pair()
+    print("microbench models_ok", flush=True)
+
+
+if __name__ == "__main__":
+    main()
