@@ -209,6 +209,14 @@ def _apply_platform():
     if p:
         import jax
         jax.config.update("jax_platforms", p)
+    _apply_compile_cache()
+
+
+def _apply_compile_cache():
+    """Persistent XLA compile cache shared with the test suite and the
+    chip-queue scripts — see flexflow_tpu/compile_cache.py for why."""
+    from flexflow_tpu.compile_cache import enable
+    enable()
 
 
 def _error_line(msg, **extra):

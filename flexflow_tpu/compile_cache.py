@@ -1,0 +1,37 @@
+"""Shared persistent XLA compile-cache setup.
+
+Chip windows on the tunnel rig are scarce and a cold model compile
+costs minutes of window; the persistent cache makes every compile after
+the first warm — across bench.py runs, the chip-queue scripts, the test
+suite (tests/subproc.CACHE_DIR points at the same directory), and the
+driver's end-of-round sweep.  Cache keys include backend and topology,
+so CPU-mesh test entries and single-chip TPU entries coexist safely.
+
+Called explicitly by harnesses (bench.py, scripts/*) rather than on
+library import so embedding applications keep control of their own
+jax.config.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable(cache_dir: str | None = None) -> None:
+    """Point jax at the repo-level ``.jax_cache`` (or ``cache_dir``).
+    ``FF_BENCH_NO_CACHE=1`` opts out (A/B hygiene when timing
+    compiles).  Never raises: the cache is an optimization."""
+    if os.environ.get("FF_BENCH_NO_CACHE"):
+        return
+    if cache_dir is None:
+        cache_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".jax_cache")
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass

@@ -130,7 +130,11 @@ _fast_max_pool.defvjp(_fast_max_pool_fwd, _fast_max_pool_bwd)
 
 
 def _use_fast_pool() -> bool:
-    return flag_enabled("FF_FAST_POOL", "fast_pool")
+    # Built-in default OFF: on the one real device kind measured so far
+    # (TPU v5 lite) the equality-mask VJP lost 6.5x to SelectAndScatter
+    # (artifacts/r5/microbench.log), so unmeasured kinds keep XLA's
+    # lowering until decide_fast_kernels.py measures a win there.
+    return flag_enabled("FF_FAST_POOL", "fast_pool", default=False)
 
 
 # ---------------------------------------------------------------------------
@@ -247,7 +251,10 @@ _conv_fast_dgrad.defvjp(_conv_fast_dgrad_fwd, _conv_fast_dgrad_bwd)
 
 
 def _use_fast_dgrad() -> bool:
-    return flag_enabled("FF_FAST_DGRAD", "fast_dgrad")
+    # Built-in default OFF — measured 2.6x slower than XLA's dilated
+    # dgrad on TPU v5 lite (artifacts/r5/microbench.log); see
+    # _use_fast_pool for the tuning story.
+    return flag_enabled("FF_FAST_DGRAD", "fast_dgrad", default=False)
 
 
 class Conv2D(Op):
@@ -388,7 +395,17 @@ class Pool2D(Op):
             strides = (1, 1) + self.stride
             padding = ((0, 0), (0, 0), (ph, ph), (pw, pw))
         if self.pool_type == "max":
-            if _use_fast_pool() and jnp.issubdtype(x.dtype, jnp.floating):
+            from .pallas_pool import (pallas_max_pool_nhwc, supported,
+                                      use_pallas_pool)
+
+            if (ctx.conv_layout == "nhwc" and use_pallas_pool()
+                    and supported(x.shape, x.dtype, self.kernel,
+                                  self.stride, self.padding)):
+                # Single-pass Pallas tile kernel for BOTH directions —
+                # see pallas_pool.py for the SelectAndScatter story.
+                y = pallas_max_pool_nhwc(x, self.kernel, self.stride,
+                                         self.padding)
+            elif _use_fast_pool() and jnp.issubdtype(x.dtype, jnp.floating):
                 y = _fast_max_pool(x, self.kernel, self.stride,
                                    self.padding, spatial)
             else:

@@ -1,0 +1,266 @@
+"""Pallas TPU max-pool kernel: single-pass forward AND backward.
+
+Why this exists (the round-5 story, artifacts/INCEPTION_MFU.md +
+artifacts/r5/microbench.log): XLA lowers the autodiff backward of
+``reduce_window(max)`` to ``SelectAndScatter``, which the round-5
+attribution charged ~7.5 ms of Inception's 53 ms step.  The first
+replacement attempt (``conv._fast_max_pool``: equality-mask scatter
+composed from whole-tensor XLA ops) was algorithmically right but
+*locality*-wrong: each of the k*k mask/pad/add passes re-streams the
+full activation through HBM, multiplying traffic by ~k*k — measured
+6.5x SLOWER than SelectAndScatter on TPU v5 lite.  This kernel runs the
+same first-match equality-mask algorithm per (batch, channel-block)
+tile held in VMEM, so the k*k passes hit on-chip memory and HBM sees
+exactly one read of x/g and one write of dx.
+
+Reference counterpart: cuDNN pooling backward (pool_2d.cu) — same
+first-match tie semantics (matches jax/XLA autodiff, pinned by
+tests/test_pallas_pool.py against ``jax.grad`` of ``reduce_window``).
+
+Layout: NHWC only (channels on the 128-lane minor dim — pallas_guide
+tiling).  NCHW callers keep the reduce_window/autodiff path; the
+library's TPU conv layout for pool-heavy nets is NHWC anyway
+(``resolve_conv_layout``).  Gating: ``FF_PALLAS_POOL`` env /
+``pallas_pool`` tuned-table key, built-in default OFF until
+``scripts/kernel_microbench.py`` measures a win on the device kind
+(the same measure-then-enable pipeline that retired _fast_max_pool).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..tuned import flag_enabled
+
+# Per-core VMEM working-set ceiling for the kernel's tile (bytes).  The
+# stem pool of Inception (147x147x64 b1 blocks) sits near ~13 MB of
+# live tile data; 16 MB is the physical VMEM.  Shapes whose estimate
+# exceeds the budget fall back to the XLA path at trace time.
+_VMEM_BUDGET = int(os.environ.get("FF_PALLAS_POOL_VMEM", 14 * 1024 * 1024))
+_MAX_KERNEL = 7  # k*k window loop is fully unrolled; cap it
+
+
+def _out_hw(h, w, kernel, stride, padding):
+    oh = (h + 2 * padding[0] - kernel[0]) // stride[0] + 1
+    ow = (w + 2 * padding[1] - kernel[1]) // stride[1] + 1
+    return oh, ow
+
+
+def _pad_input(x, kernel, stride, padding, neg):
+    """Edge-pad the spatial dims: ``padding`` with -inf (real pool
+    padding, never selected as a max), plus a zero tail so every
+    window offset can slice ``o*s + k`` rows/cols contiguously before
+    the de-stride reshape (tail rows feed only discarded positions)."""
+    (kh, kw), (sh, sw), (ph, pw) = kernel, stride, padding
+    x = jnp.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)),
+                constant_values=neg)
+    return jnp.pad(x, ((0, 0), (0, sh - 1), (0, sw - 1), (0, 0)))
+
+
+def _window(xp, i, j, oh, ow, stride):
+    """``xp[:, i + t*sh, j + u*sw, :]`` for t<oh, u<ow — strided window
+    view built from contiguous slice + de-stride reshape + index (no
+    strided slice, which Mosaic may not lower)."""
+    sh, sw = stride
+    bb, _, wq, cb = xp.shape
+    a = lax.slice_in_dim(xp, i, i + oh * sh, axis=1)
+    if sh > 1:
+        a = a.reshape(bb, oh, sh, wq, cb)[:, :, 0]
+    b = lax.slice_in_dim(a, j, j + ow * sw, axis=2)
+    if sw > 1:
+        b = b.reshape(bb, oh, ow, sw, cb)[:, :, :, 0]
+    return b
+
+
+def _max_tree(xp, kernel, stride, oh, ow):
+    y = None
+    for i in range(kernel[0]):
+        for j in range(kernel[1]):
+            w = _window(xp, i, j, oh, ow, stride)
+            y = w if y is None else jnp.maximum(y, w)
+    return y
+
+
+def _fwd_kernel(x_ref, y_ref, *, kernel, stride, padding, neg):
+    x = x_ref[...]
+    _, h, w, _ = x.shape
+    oh, ow = _out_hw(h, w, kernel, stride, padding)
+    xp = _pad_input(x, kernel, stride, padding, neg)
+    y_ref[...] = _max_tree(xp, kernel, stride, oh, ow)
+
+
+def _bwd_kernel(x_ref, g_ref, dx_ref, *, kernel, stride, padding, neg):
+    x = x_ref[...]
+    g = g_ref[...]
+    bb, h, w, cb = x.shape
+    (kh, kw), (sh, sw), (ph, pw) = kernel, stride, padding
+    oh, ow = _out_hw(h, w, kernel, stride, padding)
+    xp = _pad_input(x, kernel, stride, padding, neg)
+    y = _max_tree(xp, kernel, stride, oh, ow)
+
+    # First-match equality masks (cuDNN/XLA tie semantics: the gradient
+    # goes to the first window position attaining the max, row-major).
+    # Contributions land on padded coordinate (o*s + k_off); decompose
+    # k_off = d*s + r so each phase r accumulates UNSTRIDED (slice-
+    # aligned) into its own (T, U) plane, then interleave the phase
+    # planes back to the padded grid with stack + merge reshapes.
+    t_n = (kh - 1) // sh + oh
+    u_n = (kw - 1) // sw + ow
+    zero_plane = jnp.zeros((bb, t_n, u_n, cb), g.dtype)
+    accs = {}
+    claimed = jnp.zeros(y.shape, jnp.bool_)
+    gz = jnp.zeros((), g.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            wv = _window(xp, i, j, oh, ow, stride)
+            m = jnp.logical_and(wv == y, jnp.logical_not(claimed))
+            claimed = jnp.logical_or(claimed, m)
+            contrib = jnp.where(m, g, gz)
+            di, ri = divmod(i, sh)
+            dj, rj = divmod(j, sw)
+            placed = jnp.pad(contrib, ((0, 0), (di, t_n - oh - di),
+                                       (dj, u_n - ow - dj), (0, 0)))
+            accs[(ri, rj)] = accs.get((ri, rj), zero_plane) + placed
+    rows = []
+    for ri in range(sh):
+        cols = [accs.get((ri, rj), zero_plane) for rj in range(sw)]
+        rows.append(jnp.stack(cols, axis=3))        # (bb, T, U, sw, cb)
+    arr = jnp.stack(rows, axis=2)                   # (bb, T, sh, U, sw, cb)
+    dxq = arr.reshape(bb, t_n * sh, u_n * sw, cb)   # padded-coord grid
+    # windows may not cover the input's trailing rows/cols (e.g. 2x2 s2
+    # on an odd size); those positions get zero gradient — extend the
+    # grid before slicing
+    tail_h = max(0, ph + h - t_n * sh)
+    tail_w = max(0, pw + w - u_n * sw)
+    if tail_h or tail_w:
+        dxq = jnp.pad(dxq, ((0, 0), (0, tail_h), (0, tail_w), (0, 0)))
+    dx_ref[...] = lax.slice(
+        dxq, (0, ph, pw, 0), (bb, ph + h, pw + w, cb)).astype(dx_ref.dtype)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _grid_and_specs(shape, out_hw, cb, bb):
+    import jax.experimental.pallas as pl
+
+    b, h, w, c = shape
+    oh, ow = out_hw
+    grid = (-(-b // bb), -(-c // cb))
+    x_spec = pl.BlockSpec((bb, h, w, cb), lambda i, j: (i, 0, 0, j))
+    y_spec = pl.BlockSpec((bb, oh, ow, cb), lambda i, j: (i, 0, 0, j))
+    return grid, x_spec, y_spec
+
+
+def _compiler_params():
+    if _interpret():
+        return None
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel"))
+
+
+def _neg(dtype):
+    return float(jnp.finfo(dtype).min) if jnp.issubdtype(dtype, jnp.floating) \
+        else int(jnp.iinfo(dtype).min)
+
+
+def _tile_bytes(h, w, oh, ow, kernel, stride, cb, bb, itemsize):
+    """Live-tile estimate for the backward kernel (the larger of the
+    two directions), as the max over its two phases — the mask loop
+    (xp/y/claimed/g + phase planes live) and the interleave (planes +
+    stacked copy + padded grid + dx live; xp/claimed freed).  Used only
+    as a go/no-go against _VMEM_BUDGET."""
+    kh, kw = kernel
+    sh, sw = stride
+    hq, wq = h + 2 * stride[0], w + 2 * stride[1]  # pad upper bound
+    t_n, u_n = (kh - 1) // sh + oh, (kw - 1) // sw + ow
+    mask_loop = (hq * wq                  # xp
+                 + 4 * oh * ow            # y, g, claimed, contrib temp
+                 + sh * sw * t_n * u_n)   # phase planes
+    interleave = (2 * sh * sw * t_n * u_n  # planes + stacked copy
+                  + t_n * sh * u_n * sw    # padded-coord grid
+                  + h * w)                 # dx
+    return max(mask_loop, interleave) * cb * bb * itemsize
+
+
+def supported(x_shape, dtype, kernel, stride, padding) -> bool:
+    """Static go/no-go: NHWC 4-D floating input, modest window, and the
+    per-tile working set fits VMEM."""
+    if len(x_shape) != 4 or not jnp.issubdtype(dtype, jnp.floating):
+        return False
+    if max(kernel) > _MAX_KERNEL:
+        return False
+    b, h, w, c = x_shape
+    oh, ow = _out_hw(h, w, kernel, stride, padding)
+    if oh <= 0 or ow <= 0:
+        return False
+    cb = min(c, 128)
+    itemsize = jnp.dtype(dtype).itemsize
+    return _tile_bytes(h, w, oh, ow, kernel, stride, cb, 1,
+                       itemsize) <= _VMEM_BUDGET
+
+
+def use_pallas_pool() -> bool:
+    """Env > tuned table (device kind) > built-in OFF.  Enabled per
+    device kind by decide_fast_kernels.py once the microbench measures
+    a win there (tuned_defaults.json)."""
+    return flag_enabled("FF_PALLAS_POOL", "pallas_pool", default=False)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def pallas_max_pool_nhwc(x, kernel, stride, padding):
+    """Max pool over dims (1, 2) of an NHWC array, both directions as
+    single-pass Pallas tile kernels.  Caller must check supported()."""
+    import jax.experimental.pallas as pl
+
+    b, h, w, c = x.shape
+    oh, ow = _out_hw(h, w, kernel, stride, padding)
+    cb = min(c, 128)
+    grid, x_spec, y_spec = _grid_and_specs(x.shape, (oh, ow), cb, 1)
+    kern = functools.partial(_fwd_kernel, kernel=kernel, stride=stride,
+                             padding=padding, neg=_neg(x.dtype))
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[x_spec],
+        out_specs=y_spec,
+        out_shape=jax.ShapeDtypeStruct((b, oh, ow, c), x.dtype),
+        compiler_params=_compiler_params(),
+        interpret=_interpret(),
+    )(x)
+
+
+def _pool_fwd(x, kernel, stride, padding):
+    return pallas_max_pool_nhwc(x, kernel, stride, padding), x
+
+
+def _pool_bwd(kernel, stride, padding, x, g):
+    import jax.experimental.pallas as pl
+
+    b, h, w, c = x.shape
+    oh, ow = _out_hw(h, w, kernel, stride, padding)
+    cb = min(c, 128)
+    grid, x_spec, y_spec = _grid_and_specs(x.shape, (oh, ow), cb, 1)
+    kern = functools.partial(_bwd_kernel, kernel=kernel, stride=stride,
+                             padding=padding, neg=_neg(x.dtype))
+    dx = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[x_spec, y_spec],
+        out_specs=x_spec,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        compiler_params=_compiler_params(),
+        interpret=_interpret(),
+    )(x, g)
+    return (dx,)
+
+
+pallas_max_pool_nhwc.defvjp(_pool_fwd, _pool_bwd)

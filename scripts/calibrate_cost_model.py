@@ -25,6 +25,8 @@ import numpy as np
 
 import flexflow_tpu as ff
 from flexflow_tpu.profiling import profile_op
+from flexflow_tpu.compile_cache import enable as _enable_cache  # noqa: E402
+_enable_cache()
 from flexflow_tpu.search.cost_model import op_compute_time, spec_for_device
 
 

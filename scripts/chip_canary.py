@@ -7,10 +7,19 @@ numbers balloon.  Run alongside bench steps so each window's
 measurements carry a health stamp (mirrors the reference's practice of
 printing machine state next to throughput, e.g. its ELAPSED lines).
 """
+import os
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from flexflow_tpu.compile_cache import enable as _enable_cache  # noqa: E402
+
+_enable_cache()
 
 
 def main():

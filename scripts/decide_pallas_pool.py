@@ -1,0 +1,85 @@
+"""Enable the Pallas max-pool kernel per device kind from the on-chip
+microbench verdict — the same measure-then-enable pipeline that retired
+``_fast_max_pool`` (see decide_fast_kernels.py; reference counterpart:
+cuDNN algorithm find, src/ops/conv_2d.cu:864-922).
+
+Reads the newest ``microbench_pallas_pool_bwd_stem`` row from the
+microbench logs in ``artifacts/r5`` and writes the ``pallas_pool`` key
+of ``flexflow_tpu/tuned_defaults.json`` for this device kind: ON iff
+the measured stock/fast speedup clears 1.05 (5% margin — a tie keeps
+stock, which fuses with neighbors and has no Mosaic compile risk).
+"""
+
+import glob
+import json
+import os
+import sys
+import time
+
+R = os.path.join(os.path.dirname(__file__), "..", "artifacts", "r5")
+OUT = os.path.join(os.path.dirname(__file__), "..", "flexflow_tpu",
+                   "tuned_defaults.json")
+MARGIN = 1.05
+
+
+def newest_row():
+    best = None
+    for path in glob.glob(os.path.join(R, "microbench*.log")):
+        try:
+            with open(path) as f:
+                lines = f.read().splitlines()
+        except OSError:
+            continue
+        for line in lines:
+            if '"microbench_pallas_pool_bwd_stem"' not in line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            stamp = os.path.getmtime(path)
+            if best is None or stamp >= best[1]:
+                best = row, stamp
+    return best[0] if best else None
+
+
+def main():
+    row = newest_row()
+    if row is None:
+        print("no pallas_pool microbench row; leaving defaults")
+        return 0
+    print(row)
+    if row.get("value") is None:
+        print("pallas pool failed on chip (error row); pinning OFF")
+        on = False
+    else:
+        on = float(row["value"]) > MARGIN
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    try:
+        with open(OUT) as f:
+            table = json.load(f)
+    except (OSError, ValueError):
+        table = {}
+    table.setdefault("pallas_pool", {})[kind] = bool(on)
+    meta = table.setdefault("_meta", {}).setdefault(kind, {})
+    meta["pallas_pool"] = {
+        "decided_utc": time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime()),
+        "row": row,
+    }
+    with open(OUT, "w") as f:
+        json.dump(table, f, indent=2, sort_keys=True)
+        f.write("\n")
+    # verdict marker for the queue gate (run_if_pallas.sh) — carries the
+    # ACTUAL device kind so the gate never hardcodes one
+    with open(os.path.join(R, "pallas_verdict.json"), "w") as f:
+        json.dump({"kind": kind, "on": bool(on)}, f)
+    print(f"tuned_defaults[pallas_pool][{kind}] = {on}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,6 +26,9 @@ REPEATS = int(os.environ.get("FF_MB_REPEATS", "3"))
 
 import jax
 
+from flexflow_tpu.compile_cache import enable as _enable_cache
+_enable_cache()
+
 if os.environ.get("FF_MB_FORCE_CPU"):  # smoke-test path: the axon PJRT
     # plugin overrides JAX_PLATFORMS, so force CPU through jax.config
     jax.config.update("jax_platforms", "cpu")
@@ -63,14 +66,14 @@ def row(name, stock_s, fast_s):
 
 
 def pool_pair():
-    """Stem max-pool 3x3 s2 bwd: b128 NHWC 147x147x64 (bf16)."""
+    """Stem max-pool 3x3 s2 bwd: b128 NHWC 147x147x64 (bf16).
+    Returns the stock (reduce_window + SelectAndScatter) time so
+    pallas_pool_pair can reuse it instead of re-timing it on chip."""
     from flexflow_tpu.ops.conv import _fast_max_pool
 
     x = jnp.ones((B, 147, 147, 64), jnp.bfloat16)
 
     def stock(v):
-        y = lax.reduce_window(v, -jnp.inf, lax.max, (1, 3, 3, 1),
-                              (1, 2, 2, 1), "VALID")
         return jax.grad(lambda u: jnp.sum(
             lax.reduce_window(u, -jnp.inf, lax.max, (1, 3, 3, 1),
                               (1, 2, 2, 1), "VALID").astype(jnp.float32)))(v)
@@ -79,7 +82,9 @@ def pool_pair():
         return jax.grad(lambda u: jnp.sum(_fast_max_pool(
             u, (3, 3), (2, 2), (0, 0), (1, 2)).astype(jnp.float32)))(v)
 
-    row("pool_bwd_stem", timed(stock, x), timed(fast, x))
+    stock_s = timed(stock, x)
+    row("pool_bwd_stem", stock_s, timed(fast, x))
+    return stock_s
 
 
 def dgrad_pair():
@@ -104,6 +109,36 @@ def dgrad_pair():
     row("dgrad_s2_stem", timed(stock, dy), timed(fast, dy))
 
 
+def pallas_pool_pair(stock_s):
+    """Stem max-pool 3x3 s2 fwd+bwd: Pallas tile kernel vs the stock
+    time pool_pair already measured on the same input (reduce_window
+    fwd + SelectAndScatter bwd) — the stock arm is not re-timed.  A
+    Mosaic compile failure is caught and reported as its own row so the
+    rest of the microbench still lands."""
+    from flexflow_tpu.ops.pallas_pool import pallas_max_pool_nhwc, supported
+
+    x = jnp.ones((B, 147, 147, 64), jnp.bfloat16)
+    if not supported(x.shape, x.dtype, (3, 3), (2, 2), (0, 0)):
+        print(json.dumps({"metric": "microbench_pallas_pool_bwd_stem",
+                          "value": None, "unit": "stock/fast speedup",
+                          "vs_baseline": None,
+                          "error": "shape not supported"}), flush=True)
+        return
+
+    def fast(v):
+        return jax.grad(lambda u: jnp.sum(pallas_max_pool_nhwc(
+            u, (3, 3), (2, 2), (0, 0)).astype(jnp.float32)))(v)
+
+    try:
+        row("pallas_pool_bwd_stem", stock_s, timed(fast, x))
+    except Exception as e:  # Mosaic lowering/VMEM failures stay local
+        print(json.dumps({"metric": "microbench_pallas_pool_bwd_stem",
+                          "value": None, "unit": "stock/fast speedup",
+                          "vs_baseline": None,
+                          "error": f"{type(e).__name__}: {e}"[:300]}),
+              flush=True)
+
+
 def concat_pair():
     """Channel concat between NHWC-internal convs: stock = concat in
     NCHW (boundary transposes), fast = lane-axis concat."""
@@ -124,7 +159,8 @@ def main():
     print(json.dumps({"metric": "microbench_device",
                       "value": 1, "unit": str(dev.device_kind),
                       "vs_baseline": None}), flush=True)
-    pool_pair()
+    stock_pool_s = pool_pair()
+    pallas_pool_pair(stock_pool_s)
     dgrad_pair()
     concat_pair()
     print("microbench models_ok", flush=True)

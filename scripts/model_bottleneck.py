@@ -35,6 +35,8 @@ def op_key(op):
 
 def main():
     import bench
+    from flexflow_tpu.compile_cache import enable as _enable_cache
+    _enable_cache()
 
     model_name = "inception_v3"
     layout = None  # default: bench.py's per-model best

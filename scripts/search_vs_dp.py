@@ -34,6 +34,9 @@ MEASURE = "--measure" in sys.argv
 
 import jax
 
+from flexflow_tpu.compile_cache import enable as _enable_cache  # noqa: E402
+_enable_cache()
+
 if not MEASURE:
     jax.config.update("jax_platforms", "cpu")
 

@@ -14,6 +14,9 @@ import time
 sys.path.insert(0, ".")
 
 import jax
+
+from flexflow_tpu.compile_cache import enable as _enable_cache
+_enable_cache()
 import jax.numpy as jnp
 import numpy as np
 

@@ -25,6 +25,8 @@ import numpy as np
 
 import bench
 from flexflow_tpu.config import ParallelConfig
+from flexflow_tpu.compile_cache import enable as _enable_cache  # noqa: E402
+_enable_cache()
 from flexflow_tpu.search.simulator import Simulator
 
 

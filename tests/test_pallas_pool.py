@@ -1,0 +1,113 @@
+"""Parity of the Pallas max-pool kernel (interpret mode on the CPU
+mesh) against jax's own reduce_window + autodiff — forward values,
+backward values, and first-match tie semantics.  The on-chip speed
+verdict comes from scripts/kernel_microbench.py; this file pins
+correctness."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+
+from flexflow_tpu.ops.pallas_pool import (pallas_max_pool_nhwc, supported,
+                                          _VMEM_BUDGET)
+
+
+def _ref_pool(x, kernel, stride, padding):
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max, (1,) + kernel + (1,), (1,) + stride + (1,),
+        ((0, 0), (padding[0], padding[0]), (padding[1], padding[1]), (0, 0)))
+
+
+CASES = [
+    # (shape, kernel, stride, padding)
+    ((2, 12, 12, 8), (3, 3), (2, 2), (0, 0)),    # stem-style VALID s2
+    ((2, 13, 13, 8), (3, 3), (2, 2), (1, 1)),    # padded, odd size
+    ((1, 9, 9, 130), (3, 3), (1, 1), (1, 1)),    # s1 overlap, C > 128
+    ((3, 8, 10, 16), (2, 2), (2, 2), (0, 0)),    # non-overlap, rect
+    ((1, 7, 7, 4), (3, 2), (1, 2), (0, 1)),      # asymmetric k/s/p
+    ((1, 7, 7, 8), (2, 2), (2, 2), (0, 0)),      # windows don't cover tail
+    ((1, 10, 10, 8), (3, 3), (3, 3), (0, 0)),    # tail gap > 1
+]
+
+
+@pytest.mark.parametrize("shape,kernel,stride,padding", CASES)
+def test_forward_and_grad_match_autodiff(shape, kernel, stride, padding):
+    assert supported(shape, jnp.float32, kernel, stride, padding)
+    rng = np.random.default_rng(0)
+    # integer-valued floats: sums are exact, so mismatches are real
+    x = jnp.asarray(rng.integers(-8, 8, shape), jnp.float32)
+    ct = jnp.asarray(rng.integers(1, 5, _ref_pool(x, kernel, stride,
+                                                  padding).shape), jnp.float32)
+
+    y = pallas_max_pool_nhwc(x, kernel, stride, padding)
+    y_ref = _ref_pool(x, kernel, stride, padding)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(y_ref))
+
+    g = jax.grad(lambda v: jnp.vdot(
+        pallas_max_pool_nhwc(v, kernel, stride, padding), ct))(x)
+    g_ref = jax.grad(lambda v: jnp.vdot(
+        _ref_pool(v, kernel, stride, padding), ct))(x)
+    np.testing.assert_array_equal(np.asarray(g), np.asarray(g_ref))
+
+
+def test_tie_first_match():
+    """All-equal input: every window's gradient goes to its row-major
+    first position only (cuDNN/XLA tie rule)."""
+    x = jnp.zeros((1, 6, 6, 8), jnp.float32)
+    ct = jnp.ones((1, 3, 3, 8), jnp.float32)
+    g = jax.grad(lambda v: jnp.vdot(
+        pallas_max_pool_nhwc(v, (2, 2), (2, 2), (0, 0)), ct))(x)
+    g_ref = jax.grad(lambda v: jnp.vdot(
+        _ref_pool(v, (2, 2), (2, 2), (0, 0)), ct))(x)
+    np.testing.assert_array_equal(np.asarray(g), np.asarray(g_ref))
+    assert float(g[0, 0, 0, 0]) == 1.0 and float(g[0, 0, 1, 0]) == 0.0
+
+
+def test_bf16_close():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(2, 10, 10, 16)), jnp.bfloat16)
+    ct = jnp.ones((2, 4, 4, 16), jnp.bfloat16)
+    g = jax.grad(lambda v: jnp.vdot(
+        pallas_max_pool_nhwc(v, (3, 3), (2, 2), (0, 0)).astype(jnp.float32),
+        ct.astype(jnp.float32)))(x)
+    g_ref = jax.grad(lambda v: jnp.vdot(
+        _ref_pool(v.astype(jnp.float32), (3, 3), (2, 2), (0, 0)),
+        ct.astype(jnp.float32)))(x.astype(jnp.float32))
+    np.testing.assert_allclose(np.asarray(g, np.float32),
+                               np.asarray(g_ref), rtol=0, atol=1e-2)
+
+
+def test_supported_gates():
+    assert not supported((2, 12, 12, 8), jnp.int32, (3, 3), (2, 2), (0, 0))
+    assert not supported((12, 12, 8), jnp.float32, (3, 3), (2, 2), (0, 0))
+    assert not supported((2, 12, 12, 8), jnp.float32, (9, 9), (2, 2), (0, 0))
+    # inception stem + every maxpool shape in the sweep models fit
+    for shape, k, s, p in [
+        ((1, 147, 147, 64), (3, 3), (2, 2), (0, 0)),   # inception stem
+        ((1, 71, 71, 192), (3, 3), (2, 2), (0, 0)),
+        ((1, 112, 112, 64), (3, 3), (2, 2), (1, 1)),   # resnet stem
+        ((1, 55, 55, 96), (3, 3), (2, 2), (0, 0)),     # alexnet
+    ]:
+        assert supported(shape, jnp.bfloat16, k, s, p), (shape, _VMEM_BUDGET)
+
+
+def test_pool2d_op_uses_pallas(monkeypatch):
+    """End-to-end through the Pool2D op with the flag forced on: NHWC
+    ctx routes through the Pallas kernel and matches the stock path."""
+    monkeypatch.setenv("FF_PALLAS_POOL", "1")
+    from flexflow_tpu.op import OpContext
+    from flexflow_tpu.ops.conv import Pool2D
+    from flexflow_tpu.tensor import Tensor
+
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.integers(-8, 8, (2, 8, 13, 13)), jnp.float32)
+    t = Tensor((2, 8, 13, 13), jnp.float32, name="x")
+    op = Pool2D("p", t, 3, 3, 2, 2, 1, 1)
+    ctx_nhwc = OpContext(compute_dtype=jnp.float32, conv_layout="nhwc")
+    ctx_nchw = OpContext(compute_dtype=jnp.float32, conv_layout="nchw")
+    (y1,) = op.forward({}, [x], ctx_nhwc)
+    monkeypatch.setenv("FF_PALLAS_POOL", "0")
+    (y2,) = op.forward({}, [x], ctx_nchw)
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
