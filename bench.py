@@ -324,12 +324,16 @@ def probe_backend(attempts=None, timeout=None,
 
 def _hbm_bytes_per_step(model, batch_size, n_chips):
     """Analytic per-chip HBM traffic per training step for bandwidth-bound
-    models (DLRM): embedding rows move 3x (fwd gather read, bwd
-    scatter-add read+write) over the chip's batch shard, and every
+    models (DLRM): embedding rows move ~3x (fwd gather read, update
+    scatter read+write) over the chip's batch shard, and every DENSE
     parameter moves ~4x (fwd read, bwd-grad write, optimizer read+write)
     at FULL size — weights are replicated under data parallelism, so
-    every chip streams the whole f32 set.  Activations are small next to
-    both here."""
+    every chip streams the whole f32 set.  Tables on the sparse-update
+    path (FFConfig.sparse_embedding_updates — the default for DLRM's
+    plain SGD) are NOT streamed in full: only their gathered rows move,
+    so they are excluded from the dense-parameter term.  Activations
+    are small next to both here."""
+    sparse_tables = {t for _, t, _ in model._sparse_embedding_specs()}
     emb = 0
     params = 0
     for op in model.layers:
@@ -339,6 +343,8 @@ def _hbm_bytes_per_step(model, batch_size, n_chips):
             width = int(np.prod(out.shape[1:]))
             emb += 3 * batch_size * width * 4  # f32 table rows
         for w in getattr(op, "weights", []) or []:
+            if w.name in sparse_tables:
+                continue  # rows counted above; the table never streams
             params += 4 * int(np.prod(w.shape)) * 4  # f32 params
     return emb / max(1, n_chips) + params
 

@@ -147,6 +147,18 @@ class FFConfig:
     # dump lands here (TensorBoard-loadable) — the XLA-level complement of
     # --profiling's per-op table
     trace_dir: str = ""
+    # Sparse embedding-table updates (reference parity: the embedding
+    # backward scatter-accumulates only the touched rows,
+    # embedding.cu:192-228 — it never streams the full table).  A dense
+    # jax autodiff update instead materializes a table-shaped gradient
+    # and the optimizer rewrites every row: ~4 full-table HBM passes per
+    # step, which dominates DLRM-class models.  "auto" = use the sparse
+    # path (autodiff w.r.t. the gathered rows + scatter-add update, an
+    # EXACT rewrite of plain-SGD) whenever the optimizer is SGD with
+    # momentum=0/weight_decay=0, the table is device-placed, unshared,
+    # and the id tensor is a graph input; True forces eligible tables,
+    # False disables.
+    sparse_embedding_updates: Optional[bool] = None  # None = auto
 
     # resolved at FFModel construction
     strategies: Dict[str, ParallelConfig] = dataclasses.field(default_factory=dict)

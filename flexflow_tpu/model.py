@@ -557,6 +557,45 @@ class FFModel:
         trainable = {p.name for p in self.parameters if p.trainable}
         return trainable
 
+    def _sparse_embedding_specs(self):
+        """Embedding tables eligible for the sparse-update path
+        (FFConfig.sparse_embedding_updates): autodiff runs w.r.t. the
+        gathered rows and the update is a scatter-add — an EXACT rewrite
+        of plain SGD that avoids the dense path's ~4 full-table HBM
+        passes per step (reference embedding.cu:192-228 likewise only
+        touches the looked-up rows).  Eligibility: plain SGD (momentum 0,
+        weight decay 0 — momentum/decay touch every row, so sparsity
+        would change semantics), device-placed, unshared table, id
+        tensor is a graph input (rows can be pre-gathered from the
+        batch), training mode.  Returns [(op_name, table_name,
+        batch_pos)]."""
+        cfg = self.config
+        if cfg.sparse_embedding_updates is False:
+            return []
+        from .optimizers import SGDOptimizer as _SGD
+        opt = self.optimizer
+        if not (isinstance(opt, _SGD) and opt.momentum == 0.0
+                and opt.weight_decay == 0.0):
+            return []
+        from .ops.linear import Embedding as _Emb
+        input_uids = [t.uid for t in self.input_tensors]
+        owners: Dict[str, int] = {}
+        for op in self.layers:
+            for w in op.weights:
+                owners[w.name] = owners.get(w.name, 0) + 1
+        specs = []
+        for op in self.layers:
+            if not isinstance(op, _Emb):
+                continue
+            tname = op.w_table.name
+            if (op.inputs[0].uid in input_uids
+                    and owners.get(tname, 0) == 1
+                    and tname not in getattr(self, "_host_shardings", {})
+                    and op.w_table.trainable):
+                specs.append((op.name, tname,
+                              input_uids.index(op.inputs[0].uid)))
+        return specs
+
     def _forward_values(self, params, batch_inputs, ctx, keep_uids=None):
         constrain = self.mesh is not None and self.mesh.is_distributed
         if self.config.remat and keep_uids is not None \
@@ -578,11 +617,16 @@ class FFModel:
         conv_layout = resolve_conv_layout(cfg.conv_layout, self.layers)
         self.resolved_conv_layout = conv_layout  # introspection (bench)
 
-        def forward_full(params, batch, rng, training):
+        sparse_specs = self._sparse_embedding_specs()
+        sparse_tables = {tname for _, tname, _ in sparse_specs}
+        _ROWS = "__rows__"  # reserved trainable-dict prefix for row leaves
+
+        def forward_full(params, batch, rng, training, embedding_rows=None):
             ctx = OpContext(training=training, rng=rng,
                             compute_dtype=cfg.compute_dtype, mesh=self.mesh,
                             flash_attention=cfg.flash_attention,
-                            conv_layout=conv_layout)
+                            conv_layout=conv_layout,
+                            embedding_rows=embedding_rows)
             inputs = {uid: x for uid, x in zip(input_uids, batch[:-1])}
             # under cfg.remat, _forward_values runs sqrt(N)-segmented
             # jax.checkpoint and returns only boundaries + these uids
@@ -592,9 +636,12 @@ class FFModel:
             return values[loss_uid], values[final_uid], ctx.updates, aux
 
         def loss_and_metrics(trainable, frozen, batch, rng):
-            params = {**frozen, **trainable}
-            logits, preds, updates, aux = forward_full(params, batch, rng,
-                                                       True)
+            rows = {k[len(_ROWS):]: v for k, v in trainable.items()
+                    if k.startswith(_ROWS)}
+            params = {**frozen, **{k: v for k, v in trainable.items()
+                                   if not k.startswith(_ROWS)}}
+            logits, preds, updates, aux = forward_full(
+                params, batch, rng, True, embedding_rows=rows or None)
             labels = batch[-1]
             loss = loss_fn(logits, labels) + aux
             sums = metrics_mod.compute_batch_metrics(
@@ -606,11 +653,34 @@ class FFModel:
         def train_step(params, opt_state, batch, step):
             rng = jax.random.fold_in(jax.random.PRNGKey(cfg.seed), step)
             trainable = {k: v for k, v in params.items()
-                         if k in trainable_names}
+                         if k in trainable_names and k not in sparse_tables}
             frozen = {k: v for k, v in params.items()
-                      if k not in trainable_names}
+                      if k not in trainable_names or k in sparse_tables}
+            # sparse embedding path: gather rows OUTSIDE autodiff; the
+            # rows join the trainable pytree so grads arrive per-row
+            for op_name, tname, pos in sparse_specs:
+                idx = batch[pos].astype(jnp.int32)
+                trainable[_ROWS + op_name] = jnp.take(
+                    params[tname], idx, axis=0)
             (loss, (updates, logits, sums)), grads = grad_fn(
                 trainable, frozen, batch, rng)
+            sparse_updates = {}
+            if sparse_specs:
+                lr = self.optimizer.lr
+                for op_name, tname, pos in sparse_specs:
+                    g = grads.pop(_ROWS + op_name)
+                    trainable.pop(_ROWS + op_name)
+                    idx = batch[pos].astype(jnp.int32).reshape(-1)
+                    g2 = g.reshape(idx.shape[0], -1)
+                    # scatter-add == plain-SGD exactly: untouched rows
+                    # have zero gradient, duplicate ids accumulate.
+                    # mode="drop" mirrors the dense path for OUT-OF-RANGE
+                    # ids too: jnp.take fills NaN on the forward (both
+                    # paths see that) and its VJP DROPS the OOB
+                    # gradient, so the sparse scatter must drop as well
+                    # (tests/test_sparse_embedding.py pins this)
+                    sparse_updates[tname] = params[tname].at[idx].add(
+                        -lr * g2, mode="drop")
             host_sh = self._host_shardings
             if host_sh:
                 # unify memory spaces for the elementwise update: host params
@@ -630,7 +700,8 @@ class FFModel:
             # eager _repin_host() in train_batch/fit moves them back to
             # pinned_host (XLA's SPMD pass cannot yet shard an in-program
             # host-placement annotation on the output side)
-            new_params = {**frozen, **updates, **new_trainable}
+            new_params = {**frozen, **updates, **new_trainable,
+                          **sparse_updates}
             return new_params, new_opt_state, loss, sums
 
         per_ex_fn, loss_reduction = losses_mod.get_per_example_loss_fn(

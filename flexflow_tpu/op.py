@@ -130,6 +130,11 @@ class OpContext:
     # auxiliary losses (e.g. MoE load balancing): {op_name: scalar}; the
     # train step adds their sum to the objective
     aux_losses: Dict[str, jax.Array] = dataclasses.field(default_factory=dict)
+    # sparse embedding updates: {embedding op name: pre-gathered rows}
+    # injected by the train step so autodiff differentiates w.r.t. the
+    # ROWS (n, [bag/s,] d) instead of the full table — see
+    # FFConfig.sparse_embedding_updates
+    embedding_rows: Optional[Dict[str, jax.Array]] = None
 
 
 class Op:

@@ -132,10 +132,16 @@ class Embedding(Op):
 
     def forward(self, params, inputs, ctx: OpContext):
         idx = inputs[0].astype(jnp.int32)
-        table = params[self.w_table.name]
-        if host_placed(self.parallel_config) and ctx.mesh is not None:
+        if ctx.embedding_rows and self.name in ctx.embedding_rows:
+            # sparse-update path: the train step pre-gathered the rows
+            # and differentiates w.r.t. THEM (the table never enters the
+            # autodiff graph) — see FFConfig.sparse_embedding_updates
+            y = ctx.embedding_rows[self.name]
+        elif host_placed(self.parallel_config) and ctx.mesh is not None:
+            table = params[self.w_table.name]
             y = _host_gather(table, idx, ctx.mesh)
         else:
+            table = params[self.w_table.name]
             y = jnp.take(table, idx, axis=0)  # (n, [s,] d)
         if y.ndim == 3 and self.aggr != "none":  # bag of indices per sample
             if self.aggr == "sum":

@@ -129,8 +129,11 @@ def _bwd_kernel(x_ref, g_ref, dx_ref, *, kernel, stride, padding, neg):
     rows = []
     for ri in range(sh):
         cols = [accs.get((ri, rj), zero_plane) for rj in range(sw)]
-        rows.append(jnp.stack(cols, axis=3))        # (bb, T, U, sw, cb)
-    arr = jnp.stack(rows, axis=2)                   # (bb, T, sh, U, sw, cb)
+        # merge the W phases before stacking H phases: intermediates
+        # stay rank <= 5 (Mosaic-friendlier than one rank-6 stack)
+        rows.append(jnp.stack(cols, axis=3)
+                    .reshape(bb, t_n, u_n * sw, cb))
+    arr = jnp.stack(rows, axis=2)                   # (bb, T, sh, U*sw, cb)
     dxq = arr.reshape(bb, t_n * sh, u_n * sw, cb)   # padded-coord grid
     # windows may not cover the input's trailing rows/cols (e.g. 2x2 s2
     # on an odd size); those positions get zero gradient — extend the

@@ -1,0 +1,139 @@
+"""Sparse embedding-table updates (FFConfig.sparse_embedding_updates).
+
+The dense autodiff path materializes a table-shaped gradient and the
+optimizer rewrites every row (~4 full-table HBM passes per step); the
+sparse path differentiates w.r.t. the gathered rows and scatter-adds
+the plain-SGD update — an EXACT rewrite (reference parity: the
+embedding backward only touches looked-up rows, embedding.cu:192-228).
+These tests pin exactness against the dense path, the eligibility
+gates, and multi-device parity."""
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.models.dlrm import build_dlrm
+from flexflow_tpu.parallel.mesh import MachineMesh
+
+EMB = (50, 30)
+
+
+def _model(sparse_updates, optimizer=None, aggr="sum", mesh_shape=None,
+           bag=3):
+    cfg = ff.FFConfig(batch_size=8, compute_dtype="float32")
+    cfg.sparse_embedding_updates = sparse_updates
+    m = ff.FFModel(cfg, mesh=MachineMesh(mesh_shape or {"n": 1}))
+    ids0 = m.create_tensor((8, bag), dtype="int32", name="ids0")
+    ids1 = m.create_tensor((8, 1), dtype="int32", name="ids1")
+    e0 = m.embedding(ids0, EMB[0], 8, aggr=aggr, name="emb0")
+    e1 = m.embedding(ids1, EMB[1], 8, aggr="sum", name="emb1")
+    t = m.concat([e0, e1], axis=1)
+    t = m.dense(t, 4, activation="relu")
+    t = m.dense(t, 1)
+    p = m.mse_loss(t, reduction="average")
+    m.compile(optimizer or ff.SGDOptimizer(lr=0.1), metrics=[],
+              final_tensor=p)
+    m.init_layers(seed=0)
+    return m
+
+
+def _data(seed=1, bag=3):
+    rng = np.random.default_rng(seed)
+    # duplicate ids inside a bag AND across the batch: the scatter-add
+    # must accumulate exactly like the dense gradient
+    ids0 = rng.integers(0, EMB[0], (8, bag)).astype(np.int32)
+    ids0[0, 0] = ids0[0, 1] = ids0[1, 0]  # forced duplicates
+    ids1 = rng.integers(0, EMB[1], (8, 1)).astype(np.int32)
+    y = rng.random((8, 1)).astype(np.float32)
+    return [ids0, ids1], y
+
+
+def _run(sparse_updates, steps=4, **kw):
+    m = _model(sparse_updates, **kw)
+    xs, y = _data(bag=kw.get("bag", 3))
+    losses = [float(m.train_batch(*xs, y)) for _ in range(steps)]
+    return m, losses
+
+
+@pytest.mark.parametrize("aggr", ["sum", "avg"])
+def test_sparse_matches_dense_exactly(aggr):
+    m_s, l_s = _run(None, aggr=aggr)      # auto -> sparse path on
+    m_d, l_d = _run(False, aggr=aggr)     # dense autodiff reference
+    assert m_s._sparse_embedding_specs(), "sparse path should be active"
+    # same math, different XLA fusion/reassociation order -> float-ulp
+    # level differences only
+    np.testing.assert_allclose(l_s, l_d, rtol=1e-6, atol=1e-7)
+    for k in m_d._params:
+        np.testing.assert_allclose(
+            np.asarray(m_s._params[k]), np.asarray(m_d._params[k]),
+            rtol=0, atol=1e-6, err_msg=k)
+
+
+def test_untouched_rows_identical():
+    """Rows never looked up must be bit-identical to their init values
+    (plain SGD moves nothing without a gradient) — compare against a
+    fresh model initialized with the same seed."""
+    m, _ = _run(None, steps=2)
+    xs, _ = _data()
+    touched = set(np.asarray(xs[0]).ravel().tolist())
+    table0 = np.asarray(m._params["emb0/table"])
+    m2 = _model(None)
+    untouched = [r for r in range(EMB[0]) if r not in touched]
+    np.testing.assert_array_equal(
+        table0[untouched], np.asarray(m2._params["emb0/table"])[untouched])
+
+
+def test_eligibility_gates():
+    # momentum disqualifies (momentum decays every row every step)
+    m = _model(None, optimizer=ff.SGDOptimizer(lr=0.1, momentum=0.9))
+    assert not m._sparse_embedding_specs()
+    # adam disqualifies
+    m = _model(None, optimizer=ff.AdamOptimizer(alpha=1e-3))
+    assert not m._sparse_embedding_specs()
+    # explicit off
+    m = _model(False)
+    assert not m._sparse_embedding_specs()
+    # plain SGD qualifies, both tables
+    m = _model(None)
+    assert len(m._sparse_embedding_specs()) == 2
+
+
+def test_dlrm_builder_tables_qualify():
+    cfg = ff.FFConfig(batch_size=16, compute_dtype="float32")
+    model, inputs, preds = build_dlrm(
+        cfg, embedding_size=(100, 200), sparse_feature_size=8,
+        mlp_bot=(4, 16, 8), mlp_top=(24, 16, 1))
+    model.compile(ff.SGDOptimizer(lr=0.05), metrics=[], final_tensor=preds,
+                  mesh=MachineMesh({"n": 1}))
+    assert len(model._sparse_embedding_specs()) == 2
+
+
+def test_out_of_range_ids_match_dense():
+    """Out-of-range ids: jnp.take fills NaN on the forward (both paths
+    see identical NaN activations) and its VJP DROPS the OOB gradient —
+    the sparse scatter uses mode="drop" to match.  A mode="clip"
+    scatter would instead update the last row where the dense path
+    updates nothing (measured divergence that motivated this pin)."""
+    def run(sparse):
+        m = _model(sparse)
+        xs, y = _data()
+        xs[0][0, 0] = EMB[0] + 7          # above range -> NaN row fill
+        losses = [float(m.train_batch(*xs, y)) for _ in range(2)]
+        return m, losses
+
+    m_s, l_s = run(None)
+    m_d, l_d = run(False)
+    # NaN propagates identically (assert_allclose: equal_nan by default)
+    np.testing.assert_allclose(l_s, l_d, rtol=1e-6, atol=1e-7)
+    for k in ("emb0/table", "emb1/table"):
+        a = np.asarray(m_s._params[k])
+        b = np.asarray(m_d._params[k])
+        np.testing.assert_array_equal(np.isnan(a), np.isnan(b), err_msg=k)
+        np.testing.assert_allclose(a[~np.isnan(a)], b[~np.isnan(b)],
+                                   rtol=0, atol=1e-6, err_msg=k)
+
+
+def test_multidevice_parity():
+    _, base = _run(None, mesh_shape={"n": 1})
+    _, dp = _run(None, mesh_shape={"n": 8})
+    np.testing.assert_allclose(base, dp, rtol=2e-4, atol=2e-5)
