@@ -20,6 +20,7 @@ TPU-native re-design of the reference's god object (``include/model.h:240-429``,
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -671,6 +672,12 @@ class FFModel:
                     g = grads.pop(_ROWS + op_name)
                     trainable.pop(_ROWS + op_name)
                     idx = batch[pos].astype(jnp.int32).reshape(-1)
+                    # negative ids: take's fill-mode VJP drops them, but
+                    # .at[] would WRAP them numpy-style and poison a
+                    # real row — push them out of range so mode="drop"
+                    # drops them too (tests pin this)
+                    nrows = params[tname].shape[0]
+                    idx = jnp.where(idx < 0, nrows, idx)
                     g2 = g.reshape(idx.shape[0], -1)
                     # scatter-add == plain-SGD exactly: untouched rows
                     # have zero gradient, duplicate ids accumulate.
@@ -854,7 +861,16 @@ class FFModel:
             flat[f"opt:{i}"] = self._gather_host(leaf)
         flat["meta:step"] = np.asarray(self._step, np.int64)
         if jax.process_index() == 0:
-            np.savez(self._ckpt_path(path), **flat)
+            # atomic publish: a crash/kill mid-save must never leave a
+            # truncated file at the final name — a corrupt "newest"
+            # checkpoint would wedge every elastic-restart attempt
+            # (parallel/elastic.py resumes from the newest by step).
+            # The tmp name keeps the .npz suffix so np.savez writes
+            # exactly there (it appends .npz to suffix-less paths).
+            final = self._ckpt_path(path)
+            tmp = final[:-len(".npz")] + ".tmp.npz"
+            np.savez(tmp, **flat)
+            os.replace(tmp, final)
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("ff_checkpoint_written")

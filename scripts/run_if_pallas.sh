@@ -1,11 +1,14 @@
 #!/bin/bash
-# Full-model Inception arm with the Pallas pool kernel — but only if
-# decide_pallas_pool.py enabled it (its verdict marker carries the real
-# device kind, so nothing is hardcoded here).  When the verdict is OFF,
-# mark the queue step done so the watcher doesn't retry a known-off
-# config; when no verdict exists yet, exit without the marker so the
-# step retries after decide_pallas runs.
+# Gated full-model bench arm with the Pallas pool kernel.
+#   usage: run_if_pallas.sh <queue-step-name> [bench.py args...]
+# Runs only if decide_pallas_pool.py enabled the kernel (its verdict
+# marker carries the real device kind, so nothing is hardcoded here).
+# When the verdict is OFF, mark the queue step done so the watcher
+# doesn't retry a known-off config; when no verdict exists yet, exit
+# without the marker so the step retries after decide_pallas runs.
 cd "$(dirname "$0")/.."
+step="${1:?queue step name}"
+shift
 v=artifacts/r5/pallas_verdict.json
 if [ ! -f "$v" ]; then
   echo "no pallas verdict yet (decide_pallas hasn't run); retry next pass"
@@ -13,8 +16,8 @@ if [ ! -f "$v" ]; then
 fi
 on=$(python -c "import json; print(1 if json.load(open('$v')).get('on') else 0)")
 if [ "$on" != "1" ]; then
-  echo "pallas_pool tuned OFF for $(cat "$v"); skipping full-model arm"
-  touch artifacts/r5/incep_pallas.done
+  echo "pallas_pool tuned OFF for $(cat "$v"); skipping $step"
+  touch "artifacts/r5/$step.done"
   exit 0
 fi
-exec env FF_PALLAS_POOL=1 python bench.py --model inception_v3
+exec env FF_PALLAS_POOL=1 python bench.py "$@"

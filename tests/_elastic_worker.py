@@ -1,0 +1,102 @@
+"""Worker for the elastic-recovery test (launched via
+flexflow_tpu.parallel.elastic.run_elastic by tests/test_elastic.py).
+
+Demonstrates the standard elastic resume pattern: load the newest
+checkpoint if one exists (params + optimizer state + step), train to
+TOTAL_STEPS with per-step deterministic batches, checkpointing every
+CKPT_EVERY steps.  Failure injection: rank KILL_RANK dies hard
+(os._exit) after KILL_AFTER_STEP steps on attempt 0 only
+(FF_ELASTIC_ATTEMPT is exported by the launcher) — a later attempt must
+resume from the last checkpoint and finish with the exact losses of an
+uninterrupted run.
+
+argv: <coordinator_port> <rank> <nprocs> <workdir> <devices_per_proc>
+Writes "<workdir>/final_<rank>.txt" with the last-step loss.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BATCH = 32
+TOTAL_STEPS = 6
+CKPT_EVERY = 2
+KILL_RANK = 1
+KILL_AFTER_STEP = 3
+
+
+def build_model():
+    import flexflow_tpu as ff
+
+    cfg = ff.FFConfig(batch_size=BATCH, compute_dtype="float32")
+    model = ff.FFModel(cfg, mesh=ff.MachineMesh({"n": 4}))
+    x = model.create_tensor((BATCH, 16), name="x")
+    t = model.dense(x, 32, activation="relu", name="fc1")
+    t = model.dense(t, 4, name="fc2")
+    model.compile(ff.SGDOptimizer(lr=0.1, momentum=0.9),
+                  ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY, ["accuracy"],
+                  final_tensor=t)
+    model.init_layers(seed=0)
+    return model
+
+
+def step_batch(step: int):
+    """Deterministic per-step batch — every rank feeds the same data
+    (SPMD) and a resumed run replays the exact remaining sequence."""
+    import numpy as np
+
+    rng = np.random.default_rng(1000 + step)
+    xd = rng.standard_normal((BATCH, 16)).astype(np.float32)
+    yd = rng.integers(0, 4, (BATCH, 1)).astype(np.int32)
+    return xd, yd
+
+
+def main():
+    port, rank, nprocs, workdir, dev_per_proc = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4],
+        int(sys.argv[5]))
+    attempt = int(os.environ.get("FF_ELASTIC_ATTEMPT", "0"))
+
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={dev_per_proc}")
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from flexflow_tpu.parallel.distributed import (coordination_barrier,
+                                                   initialize_distributed)
+    from flexflow_tpu.parallel.elastic import latest_checkpoint
+
+    assert initialize_distributed(coordinator_address=f"localhost:{port}",
+                                  num_processes=nprocs, process_id=rank)
+
+    model = build_model()
+    xd, yd = step_batch(0)
+    model.warmup_compile(xd, yd)
+    coordination_barrier("ff_elastic_compiled", timeout_s=240)
+
+    ckpt = latest_checkpoint(workdir)
+    if ckpt is not None:
+        model.load_checkpoint(ckpt)
+
+    while model._step < TOTAL_STEPS:
+        step = model._step
+        xd, yd = step_batch(step)
+        loss = float(model.train_batch(xd, yd))
+        done = model._step  # train_batch increments
+        if done % CKPT_EVERY == 0 and done < TOTAL_STEPS:
+            model.save_checkpoint(
+                os.path.join(workdir, f"elastic_step{done}"))
+        if (attempt == 0 and rank == KILL_RANK
+                and done == KILL_AFTER_STEP):
+            os._exit(17)  # simulated hard crash (no cleanup, no excepthook)
+
+    with open(os.path.join(workdir, f"final_{rank}.txt"), "w") as f:
+        f.write(f"{loss:.9f}\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -118,6 +118,8 @@ def test_out_of_range_ids_match_dense():
         m = _model(sparse)
         xs, y = _data()
         xs[0][0, 0] = EMB[0] + 7          # above range -> NaN row fill
+        xs[1][2, 0] = -1                  # negative sentinel: take-VJP
+        # drops it; an unsanitized scatter would WRAP to the last row
         losses = [float(m.train_batch(*xs, y)) for _ in range(2)]
         return m, losses
 
@@ -137,3 +139,33 @@ def test_multidevice_parity():
     _, base = _run(None, mesh_shape={"n": 1})
     _, dp = _run(None, mesh_shape={"n": 8})
     np.testing.assert_allclose(base, dp, rtol=2e-4, atol=2e-5)
+
+
+def test_remat_compose():
+    """Rows are closure-captured by the sqrt(N)-segmented jax.checkpoint
+    under cfg.remat; gradients must still flow to them (jax treats
+    closed-over tracers as implicit arguments of the remat jaxpr)."""
+    def run(remat, sparse):
+        cfg = ff.FFConfig(batch_size=8, compute_dtype="float32")
+        cfg.remat = remat
+        cfg.sparse_embedding_updates = sparse
+        m = ff.FFModel(cfg, mesh=MachineMesh({"n": 1}))
+        ids = m.create_tensor((8, 3), dtype="int32", name="ids")
+        t = m.embedding(ids, 50, 8, aggr="sum", name="emb0")
+        t = m.dense(t, 16, activation="relu")
+        t = m.dense(t, 8, activation="relu")
+        t = m.dense(t, 1)
+        p = m.mse_loss(t, reduction="average")
+        m.compile(ff.SGDOptimizer(lr=0.1), metrics=[], final_tensor=p)
+        m.init_layers(seed=0)
+        rng = np.random.default_rng(1)
+        ids_v = rng.integers(0, 50, (8, 3)).astype(np.int32)
+        y = rng.random((8, 1)).astype(np.float32)
+        losses = [float(m.train_batch(ids_v, y)) for _ in range(3)]
+        return np.asarray(m._params["emb0/table"]), losses
+
+    t_rs, l_rs = run(True, None)
+    t_rd, l_rd = run(True, False)
+    assert all(np.isfinite(l_rs)) and l_rs[-1] < l_rs[0]
+    np.testing.assert_allclose(l_rs, l_rd, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(t_rs, t_rd, rtol=0, atol=1e-6)
