@@ -332,6 +332,19 @@ class Conv2D(Op):
         # 0.30ms ideal at C_in=3, scripts/calibrate_cost_model.py)
         return min(1.0, self.in_channels / 8.0)
 
+    def backward_overhead(self):
+        # strided dgrad lowers to a conv over the interior-dilated
+        # gradient (~s*s MAC waste).  r5 calibration, conv7x7/s2 row:
+        # analytic fwd 0.411 + bwd 0.820 = 1.231 ms vs measured 3.155 ms
+        # with fwd alone matching (0.371) -> measured bwd 2.78 ms =
+        # 3.4x the 2x-forward model.  Stride-1 conv rows match the model
+        # (1.06-1.12x), no correction.  Deliberately does NOT consult
+        # _use_fast_dgrad(): the tuned table never ships fast_dgrad on
+        # TPU (microbench: the phase decomposition is 2.6x slower than
+        # the dilated lowering there), and on the CPU test backend these
+        # TPU-calibrated factors are nominal either way.
+        return 3.4 if max(self.stride) > 1 else 1.0
+
     def flops(self):
         n, c_out, oh, ow = self.outputs[0].shape
         kh, kw = self.kernel
@@ -427,3 +440,22 @@ class Pool2D(Op):
 
     def flops(self):
         return self.outputs[0].volume * self.kernel[0] * self.kernel[1]
+
+    def backward_overhead(self):
+        # max-pool backward lowers to SelectAndScatter: r5 calibration
+        # measured the pool2x2 row at 1.9x its bandwidth roofline
+        # (BASELINE.md); avg-pool backward is a plain dilated sum, on
+        # roofline.  The overhead is gone only when the Pallas tile
+        # kernel would actually run: tuned ON for this device kind AND
+        # this op's shape/window inside the kernel's support envelope
+        # (layout is approximated as NHWC here — that is what the
+        # library's TPU auto resolves for pool-heavy graphs).
+        if self.pool_type != "max":
+            return 1.0
+        from .pallas_pool import supported, use_pallas_pool
+        if use_pallas_pool():
+            n, c, h, w = self.inputs[0].shape
+            if supported((n, h, w, c), self.inputs[0].dtype, self.kernel,
+                         self.stride, self.padding):
+                return 1.0
+        return 1.9

@@ -104,7 +104,14 @@ def op_compute_time(op: Op, part_degrees: Tuple[int, ...],
     io_bytes /= max(1, nparts)
     if backward:
         io_bytes *= 2.0
-    return max(flops / peak, io_bytes / spec.hbm_bw) + spec.kernel_launch
+    t = max(flops / peak, io_bytes / spec.hbm_bw)
+    if backward:
+        # calibrated lowering overhead (Op.backward_overhead): applied to
+        # the whole backward roofline term, since the measured excess is
+        # in the kernel the backward lowers TO (SelectAndScatter /
+        # dilated dgrad), whichever side of the roofline binds
+        t *= op.backward_overhead()
+    return t + spec.kernel_launch
 
 
 # Ops whose outputs XLA never materializes as standalone HBM buffers in a

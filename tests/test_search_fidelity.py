@@ -210,3 +210,30 @@ def test_measure_mode_times_tp_subproblem():
     assert 0 < t_tp < np.inf
     b_full = sim._op_time(fc, (1, 1), backward=True)
     assert 0 < b_full < np.inf
+
+
+def test_calibrated_backward_overheads(monkeypatch):
+    """The r5 on-chip calibration's two systematic under-predictions are
+    corrected in analytic mode (Op.backward_overhead): max-pool bwd 1.9x
+    (SelectAndScatter), stride>1 conv dgrad 3.4x (dilated lowering).
+    Avg pool and stride-1 convs stay on the 2x-forward model."""
+    from flexflow_tpu.ops.conv import Pool2D
+    from flexflow_tpu.search.cost_model import DEFAULT_SPEC, op_compute_time
+
+    monkeypatch.setenv("FF_PALLAS_POOL", "0")  # hermetic vs env/tuned table
+    t = Tensor((8, 64, 28, 28), name="x")
+    mx = Pool2D("mp", t, 2, 2, 2, 2, 0, 0, pool_type="max")
+    av = Pool2D("ap", t, 2, 2, 2, 2, 0, 0, pool_type="avg")
+    assert mx.backward_overhead() == 1.9 and av.backward_overhead() == 1.0
+    b_mx = op_compute_time(mx, (1,), DEFAULT_SPEC, backward=True)
+    b_av = op_compute_time(av, (1,), DEFAULT_SPEC, backward=True)
+    launch = DEFAULT_SPEC.kernel_launch
+    np.testing.assert_allclose(b_mx - launch, 1.9 * (b_av - launch),
+                               rtol=1e-6)
+
+    c1 = Conv2D("c1", t, 64, 3, 3, 1, 1, 1, 1)
+    c2 = Conv2D("c2", t, 64, 3, 3, 2, 2, 1, 1)
+    assert c1.backward_overhead() == 1.0 and c2.backward_overhead() == 3.4
+    f2 = op_compute_time(c2, (1,), DEFAULT_SPEC, backward=False)
+    b2 = op_compute_time(c2, (1,), DEFAULT_SPEC, backward=True)
+    assert b2 > 2.0 * (f2 - launch)  # strictly above the naive 2x model
