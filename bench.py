@@ -505,20 +505,38 @@ def _subprocess_bench(budget_s):
         env["FF_BENCH_PROBE_TIMEOUT"] = "60"
         env["FF_BENCH_MAX_WAIT"] = "150"  # 2 x 60s + 30s backoff
         env["FF_BENCH_CHILD"] = "1"  # suppress interim probe stdout lines
-        try:
-            p = subprocess.run(cmd, capture_output=True, text=True,
-                               timeout=timeout, env=env)
-        except subprocess.TimeoutExpired as e:
-            # keep the child's partial output: it distinguishes a tunnel
-            # hang (probe logs) from a slow compile (no output yet)
-            def _tail(b):
-                s = b.decode(errors="replace") if isinstance(b, bytes) \
-                    else (b or "")
-                return s.strip()[-140:]  # both tails must survive
-                # run_sweep's 400-char error-row cap
-            raise RuntimeError(
-                f"killed after {timeout:.0f}s; child stdout: "
-                f"{_tail(e.stdout)!r} stderr: {_tail(e.stderr)!r}") from e
+        def run_once():
+            try:
+                return subprocess.run(cmd, capture_output=True, text=True,
+                                      timeout=timeout, env=env)
+            except subprocess.TimeoutExpired as e:
+                # keep the child's partial output: it distinguishes a
+                # tunnel hang (probe logs) from a slow compile (none yet)
+                def _tail(b):
+                    s = b.decode(errors="replace") if isinstance(b, bytes) \
+                        else (b or "")
+                    return s.strip()[-140:]  # both tails must survive
+                    # run_sweep's 400-char error-row cap
+                raise RuntimeError(
+                    f"killed after {timeout:.0f}s; child stdout: "
+                    f"{_tail(e.stdout)!r} stderr: {_tail(e.stderr)!r}") from e
+
+        p = run_once()
+        if p.returncode in (134, -6) or "Fatal Python error" in (p.stderr
+                                                                 or ""):
+            # a truncated entry in the shared persistent compile cache
+            # ABORTS the reader inside XLA deserialization (observed:
+            # SIGABRT poisoned every run until the cache was wiped) —
+            # clear it and retry this model once
+            import shutil
+
+            from flexflow_tpu.compile_cache import default_dir
+            cache = default_dir()
+            print(f"# child aborted (rc={p.returncode}); clearing compile "
+                  f"cache {cache} and retrying once", file=sys.stderr,
+                  flush=True)
+            shutil.rmtree(cache, ignore_errors=True)
+            p = run_once()
         return _parse_child_row(p.stdout, p.returncode, p.stderr)
     return f
 

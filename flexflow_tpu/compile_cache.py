@@ -17,21 +17,47 @@ from __future__ import annotations
 import os
 
 
+def default_dir() -> str:
+    """The chip-surface cache directory (repo-level ``.jax_cache_chip``)
+    — the ONE spelling shared by enable(), bench.py's abort-recovery
+    clear, and the tests."""
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".jax_cache_chip")
+
+
 def enable(cache_dir: str | None = None) -> None:
-    """Point jax at the repo-level ``.jax_cache`` (or ``cache_dir``).
-    ``FF_BENCH_NO_CACHE=1`` opts out (A/B hygiene when timing
-    compiles).  Never raises: the cache is an optimization."""
+    """Point jax at the repo-level ``.jax_cache_chip`` (or
+    ``cache_dir``).  ``FF_BENCH_NO_CACHE=1`` opts out (A/B hygiene when
+    timing compiles).  Never raises: the cache is an optimization.
+
+    Deliberately a DIFFERENT directory from the test suite's
+    ``.jax_cache`` (tests/subproc.CACHE_DIR): chip-side processes (axon
+    backend) also emit XLA:CPU entries for host-side glue whose machine
+    feature strings differ from the CPU-mesh suite's, and loading a
+    foreign-featured AOT entry can SIGILL/abort the reader (observed:
+    cpu_aot_loader 'machine type ... doesn't match' followed by a fatal
+    abort in the suite).  One surface, one cache."""
     if os.environ.get("FF_BENCH_NO_CACHE"):
         return
     if cache_dir is None:
-        cache_dir = os.path.join(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            ".jax_cache")
+        cache_dir = default_dir()
     try:
         import jax
 
         jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        # Cache only compiles that cost real time: the tiny-jit entries
+        # (bernoulli, broadcast, ...) are cheap to redo but multiply the
+        # on-disk write volume ~10x, and every write is a chance for a
+        # killed process (timeouts are routine on this rig) to leave a
+        # stale/truncated entry behind.  Cross-session reuse is safe
+        # HERE because chip programs are single-device (no collectives)
+        # — multi-device CPU executables deserialized from stale entries
+        # can deadlock their collective rendezvous and abort (see
+        # tests/conftest.py, which session-scopes the TEST cache for
+        # exactly that reason); bench's sweep additionally clears this
+        # dir and retries once if a child aborts.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     except Exception:
         pass

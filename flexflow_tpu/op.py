@@ -190,14 +190,17 @@ class Op:
         measures ~2x its ideal roofline time (calibration)."""
         return 1.0
 
-    def backward_overhead(self) -> float:
+    def backward_overhead(self, part_degrees=None) -> float:
         """Multiplier on the backward roofline for ops whose TPU
         backward lowering systematically exceeds the 2x-forward model
         (default 1.0).  Grounded in the round-5 on-chip calibration
         (BASELINE.md "Cost-model calibration"): max-pool backward lowers
         to SelectAndScatter (measured 1.9x the roofline row pool2x2),
         strided-conv dgrad to an interior-dilated conv (conv7x7/s2
-        fwd+bwd measured 2.6x while its fwd alone matches).  Kept as an
+        fwd+bwd measured 2.6x while its fwd alone matches).
+        ``part_degrees`` is the strategy split under evaluation — ops
+        whose lowering depends on HOW they're split (Pool2D: the Pallas
+        kernel only runs for non-spatial splits) consult it.  Kept as an
         analytic-mode correction only — measure mode times the real
         kernels and never consults this."""
         return 1.0

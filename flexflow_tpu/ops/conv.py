@@ -332,7 +332,7 @@ class Conv2D(Op):
         # 0.30ms ideal at C_in=3, scripts/calibrate_cost_model.py)
         return min(1.0, self.in_channels / 8.0)
 
-    def backward_overhead(self):
+    def backward_overhead(self, part_degrees=None):
         # strided dgrad lowers to a conv over the interior-dilated
         # gradient (~s*s MAC waste).  r5 calibration, conv7x7/s2 row:
         # analytic fwd 0.411 + bwd 0.820 = 1.231 ms vs measured 3.155 ms
@@ -411,13 +411,18 @@ class Pool2D(Op):
             from .pallas_pool import (pallas_max_pool_nhwc, supported,
                                       use_pallas_pool)
 
-            if (ctx.conv_layout == "nhwc" and use_pallas_pool()
-                    and supported(x.shape, x.dtype, self.kernel,
-                                  self.stride, self.padding)):
+            use_pallas = (ctx.conv_layout == "nhwc" and use_pallas_pool()
+                          and supported(x.shape, x.dtype, self.kernel,
+                                        self.stride, self.padding))
+            if use_pallas and (ctx.mesh is None
+                               or not ctx.mesh.is_distributed):
                 # Single-pass Pallas tile kernel for BOTH directions —
                 # see pallas_pool.py for the SelectAndScatter story.
                 y = pallas_max_pool_nhwc(x, self.kernel, self.stride,
                                          self.padding)
+            elif use_pallas and (y := self._pallas_pool_sharded(
+                    x, ctx.mesh)) is not None:
+                pass  # shard_map-lifted kernel (batch/channel splits)
             elif _use_fast_pool() and jnp.issubdtype(x.dtype, jnp.floating):
                 y = _fast_max_pool(x, self.kernel, self.stride,
                                    self.padding, spatial)
@@ -435,23 +440,67 @@ class Pool2D(Op):
             y = jnp.transpose(y, (0, 3, 1, 2))
         return [y]
 
+    def _spatially_split(self) -> bool:
+        """True when this op's resolved strategy splits the h/w dims —
+        the one case the halo-free shard_map lift cannot express."""
+        pc = self.parallel_config
+        return pc is not None and len(pc.dims) >= 4 \
+            and (pc.dims[2] > 1 or pc.dims[3] > 1)
+
+    def _pallas_pool_sharded(self, x, mesh):
+        """shard_map-lifted Pallas pool for distributed meshes.  GSPMD
+        treats a bare pallas_call as an opaque custom call and would
+        all-gather the operand (verified on the 8-dev mesh), so the
+        kernel must run per-shard under manual sharding.  Pooling is
+        independent per sample, so the batch (n) mesh axes shard
+        halo-free; the lift deliberately shards ONLY over n — pool
+        strategies never c-split activations (parallel_dims), and
+        unmentioned mesh axes are replicated, which matches the
+        activation's actual state under dp/tp.  An h/w-splitting
+        strategy on THIS op falls back to the XLA lowering (returns
+        None): the spec would have to all-gather real spatial shards.
+        ``x`` is NHWC here."""
+        import jax as _jax
+        from jax.sharding import PartitionSpec as _P
+
+        from .pallas_pool import pallas_max_pool_nhwc
+
+        if self._spatially_split():
+            return None
+        n_axes = mesh.subaxes("n")
+        if not n_axes or x.shape[0] % mesh.axis_size("n"):
+            return None
+        spec = _P(n_axes, None, None, None)
+
+        def kern(v):  # positional call keeps custom_vjp nondiff args intact
+            return pallas_max_pool_nhwc(v, self.kernel, self.stride,
+                                        self.padding)
+
+        return _jax.shard_map(kern, mesh=mesh.mesh, in_specs=(spec,),
+                              out_specs=spec, check_vma=False)(x)
+
     def parallel_dims(self):
         return (True, False, True, True)
 
     def flops(self):
         return self.outputs[0].volume * self.kernel[0] * self.kernel[1]
 
-    def backward_overhead(self):
+    def backward_overhead(self, part_degrees=None):
         # max-pool backward lowers to SelectAndScatter: r5 calibration
         # measured the pool2x2 row at 1.9x its bandwidth roofline
         # (BASELINE.md); avg-pool backward is a plain dilated sum, on
         # roofline.  The overhead is gone only when the Pallas tile
-        # kernel would actually run: tuned ON for this device kind AND
-        # this op's shape/window inside the kernel's support envelope
-        # (layout is approximated as NHWC here — that is what the
-        # library's TPU auto resolves for pool-heavy graphs).
+        # kernel would actually run: tuned ON for this device kind,
+        # shape/window inside the kernel's support envelope (layout
+        # approximated as NHWC — the library's TPU auto for pool-heavy
+        # graphs), and the split under evaluation not spatial — an
+        # h/w-splitting strategy takes the XLA fallback at runtime
+        # (Pool2D._pallas_pool_sharded) and really pays the 1.9x.
         if self.pool_type != "max":
             return 1.0
+        if part_degrees is not None and len(part_degrees) >= 4 \
+                and (part_degrees[2] > 1 or part_degrees[3] > 1):
+            return 1.9
         from .pallas_pool import supported, use_pallas_pool
         if use_pallas_pool():
             n, c, h, w = self.inputs[0].shape

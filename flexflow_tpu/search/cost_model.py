@@ -110,7 +110,7 @@ def op_compute_time(op: Op, part_degrees: Tuple[int, ...],
         # the whole backward roofline term, since the measured excess is
         # in the kernel the backward lowers TO (SelectAndScatter /
         # dilated dgrad), whichever side of the roofline binds
-        t *= op.backward_overhead()
+        t *= op.backward_overhead(part_degrees)
     return t + spec.kernel_launch
 
 

@@ -199,3 +199,34 @@ def test_subprocess_bench_marks_children(monkeypatch):
     monkeypatch.setattr(bench.subprocess, "run", fake_run)
     bench._subprocess_bench(600.0)("alexnet", 0, 5)
     assert captured["FF_BENCH_CHILD"] == "1"
+
+
+def test_child_abort_clears_cache_and_retries(monkeypatch, tmp_path):
+    """A SIGABRT child (the poisoned-compile-cache failure mode: a
+    truncated entry aborts XLA deserialization) must trigger one
+    cache-clear + retry instead of recording a dead model row."""
+    import os
+    import subprocess
+    import types
+
+    from flexflow_tpu.compile_cache import default_dir
+    cache = default_dir()
+    calls = []
+    good = json.dumps({"metric": "alexnet_train_samples_per_sec_per_chip",
+                       "value": 100.0})
+
+    def fake_run(cmd, capture_output, text, timeout, env):
+        calls.append(list(cmd))
+        rc = 134 if len(calls) == 1 else 0
+        out = "" if rc else good + "\n"
+        return types.SimpleNamespace(returncode=rc, stdout=out, stderr="")
+
+    cleared = []
+    import shutil
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(shutil, "rmtree",
+                        lambda p, ignore_errors=False: cleared.append(p))
+    row = bench._subprocess_bench(600.0)("alexnet", 0, 5)
+    assert row["value"] == 100.0
+    assert len(calls) == 2, "abort must retry exactly once"
+    assert cleared == [cache], "retry must clear the shared compile cache"
