@@ -408,25 +408,27 @@ class Pool2D(Op):
             strides = (1, 1) + self.stride
             padding = ((0, 0), (0, 0), (ph, ph), (pw, pw))
         if self.pool_type == "max":
+            y = None
             from .pallas_pool import (pallas_max_pool_nhwc, supported,
                                       use_pallas_pool)
 
-            use_pallas = (ctx.conv_layout == "nhwc" and use_pallas_pool()
-                          and supported(x.shape, x.dtype, self.kernel,
-                                        self.stride, self.padding))
-            if use_pallas and (ctx.mesh is None
-                               or not ctx.mesh.is_distributed):
+            if (ctx.conv_layout == "nhwc" and use_pallas_pool()
+                    and supported(x.shape, x.dtype, self.kernel,
+                                  self.stride, self.padding)):
                 # Single-pass Pallas tile kernel for BOTH directions —
-                # see pallas_pool.py for the SelectAndScatter story.
-                y = pallas_max_pool_nhwc(x, self.kernel, self.stride,
-                                         self.padding)
-            elif use_pallas and (y := self._pallas_pool_sharded(
-                    x, ctx.mesh)) is not None:
-                pass  # shard_map-lifted kernel (batch/channel splits)
-            elif _use_fast_pool() and jnp.issubdtype(x.dtype, jnp.floating):
+                # see pallas_pool.py for the SelectAndScatter story;
+                # distributed meshes get the shard_map lift (None when
+                # the split can't be expressed halo-free)
+                if ctx.mesh is None or not ctx.mesh.is_distributed:
+                    y = pallas_max_pool_nhwc(x, self.kernel, self.stride,
+                                             self.padding)
+                else:
+                    y = self._pallas_pool_sharded(x, ctx.mesh)
+            if y is None and _use_fast_pool() \
+                    and jnp.issubdtype(x.dtype, jnp.floating):
                 y = _fast_max_pool(x, self.kernel, self.stride,
                                    self.padding, spatial)
-            else:
+            if y is None:
                 init = (-jnp.inf
                         if jnp.issubdtype(x.dtype, jnp.floating)
                         else jnp.iinfo(x.dtype).min)
@@ -440,12 +442,18 @@ class Pool2D(Op):
             y = jnp.transpose(y, (0, 3, 1, 2))
         return [y]
 
+    @staticmethod
+    def _splits_spatial(dims) -> bool:
+        """Do these (n, c, h, w) split degrees touch the h/w dims — the
+        one case the halo-free shard_map lift cannot express?  Shared by
+        the runtime route (resolved strategy) and the analytic cost
+        model (candidate degrees)."""
+        return dims is not None and len(dims) >= 4 \
+            and (dims[2] > 1 or dims[3] > 1)
+
     def _spatially_split(self) -> bool:
-        """True when this op's resolved strategy splits the h/w dims —
-        the one case the halo-free shard_map lift cannot express."""
         pc = self.parallel_config
-        return pc is not None and len(pc.dims) >= 4 \
-            and (pc.dims[2] > 1 or pc.dims[3] > 1)
+        return pc is not None and self._splits_spatial(pc.dims)
 
     def _pallas_pool_sharded(self, x, mesh):
         """shard_map-lifted Pallas pool for distributed meshes.  GSPMD
@@ -498,8 +506,7 @@ class Pool2D(Op):
         # (Pool2D._pallas_pool_sharded) and really pays the 1.9x.
         if self.pool_type != "max":
             return 1.0
-        if part_degrees is not None and len(part_degrees) >= 4 \
-                and (part_degrees[2] > 1 or part_degrees[3] > 1):
+        if self._splits_spatial(part_degrees):
             return 1.9
         from .pallas_pool import supported, use_pallas_pool
         if use_pallas_pool():

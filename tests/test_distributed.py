@@ -17,7 +17,6 @@ identical model — the reference's GASNet multi-node path
 (FlexFlow.mk:68-69) validated without a cluster."""
 
 import os
-import socket
 import subprocess
 import sys
 
@@ -27,10 +26,7 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
+from flexflow_tpu.parallel.elastic import free_port as _free_port  # noqa: E402
 
 
 def _run_workers(nprocs, dev_per_proc, shape, tmp_path, timeout):
