@@ -147,6 +147,18 @@ class FFConfig:
     # dump lands here (TensorBoard-loadable) — the XLA-level complement of
     # --profiling's per-op table
     trace_dir: str = ""
+    # Gradient accumulation: split each batch into k equal microbatches
+    # inside the ONE jitted train step (lax.scan), accumulate grads, and
+    # apply a single optimizer update — activation memory scales with
+    # the microbatch while the effective batch stays cfg.batch_size.
+    # Equivalent to the full-batch step for deterministic forwards under
+    # both mean- and sum-reduced losses (loss/metric sums exact with
+    # equal microbatch sizes).  Caveats: dropout draws a fresh mask per
+    # microbatch (a DIFFERENT, equally valid realization than one
+    # full-batch mask), and batchnorm running stats take the LAST
+    # microbatch's measurement once per step.  batch_size must divide
+    # by k (checked at compile()).
+    gradient_accumulation_steps: int = 1
     # Sparse embedding-table updates (reference parity: the embedding
     # backward scatter-accumulates only the touched rows,
     # embedding.cu:192-228 — it never streams the full table).  A dense
@@ -223,6 +235,8 @@ class FFConfig:
                 cfg.remat = True
             elif a == "--conv-layout":
                 cfg.conv_layout = val().lower()
+            elif a == "--accum-steps":
+                cfg.gradient_accumulation_steps = int(val())
             # unknown flags pass through (reference forwards Legion flags)
             i += 1
         return cfg

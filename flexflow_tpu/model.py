@@ -398,6 +398,16 @@ class FFModel:
                 self.label_tensor = Tensor(self._final_tensor.shape,
                                            "float32", "label")
 
+        if cfg.gradient_accumulation_steps < 1:
+            raise ValueError(
+                f"gradient_accumulation_steps must be >= 1, got "
+                f"{cfg.gradient_accumulation_steps}")
+        if cfg.gradient_accumulation_steps > 1 \
+                and cfg.batch_size % cfg.gradient_accumulation_steps:
+            raise ValueError(
+                f"batch_size {cfg.batch_size} must divide into "
+                f"gradient_accumulation_steps="
+                f"{cfg.gradient_accumulation_steps} equal microbatches")
         self._resolve_host_placements()
         self._build_step_fns()
         self._compiled = True
@@ -573,6 +583,11 @@ class FFModel:
         cfg = self.config
         if cfg.sparse_embedding_updates is False:
             return []
+        if cfg.gradient_accumulation_steps > 1:
+            # per-microbatch row gathers can't express ONE accumulated
+            # update (different ids per microbatch); dense grads
+            # accumulate naturally, so accumulation keeps the dense path
+            return []
         from .optimizers import SGDOptimizer as _SGD
         opt = self.optimizer
         if not (isinstance(opt, _SGD) and opt.momentum == 0.0
@@ -650,6 +665,9 @@ class FFModel:
             return loss, (updates, preds, sums)
 
         grad_fn = jax.value_and_grad(loss_and_metrics, has_aux=True)
+        per_ex_fn, loss_reduction = losses_mod.get_per_example_loss_fn(
+            self.loss_type)
+        self._loss_reduction = loss_reduction
 
         def train_step(params, opt_state, batch, step):
             rng = jax.random.fold_in(jax.random.PRNGKey(cfg.seed), step)
@@ -663,8 +681,43 @@ class FFModel:
                 idx = batch[pos].astype(jnp.int32)
                 trainable[_ROWS + op_name] = jnp.take(
                     params[tname], idx, axis=0)
-            (loss, (updates, logits, sums)), grads = grad_fn(
-                trainable, frozen, batch, rng)
+            accum = int(cfg.gradient_accumulation_steps)
+            if accum == 1:
+                (loss, (updates, logits, sums)), grads = grad_fn(
+                    trainable, frozen, batch, rng)
+            else:
+                # scan over k equal microbatches: activations live one
+                # microbatch at a time, grads accumulate at param size,
+                # ONE optimizer update applies below.  Loss/metric SUMS
+                # are exact (equal sizes); batchnorm stats keep the last
+                # microbatch's measurement (one momentum step per
+                # optimizer step) — see FFConfig.gradient_accumulation_steps
+                micro = tuple(
+                    a.reshape((accum, a.shape[0] // accum) + a.shape[1:])
+                    for a in batch)
+                zero_g = jax.tree.map(jnp.zeros_like, trainable)
+
+                def micro_body(acc_g, i):
+                    mb = tuple(a[i] for a in micro)
+                    (l, (upd, _lg, s)), g = grad_fn(
+                        trainable, frozen, mb, jax.random.fold_in(rng, i))
+                    return jax.tree.map(jnp.add, acc_g, g), (l, s, upd)
+
+                acc_g, (ls, ss, upds) = jax.lax.scan(
+                    micro_body, zero_g, jnp.arange(accum))
+                sums = jax.tree.map(lambda a: jnp.sum(a, axis=0), ss)
+                updates = jax.tree.map(lambda a: a[-1], upds)
+                if loss_reduction == "sum":
+                    # sum-reduced loss: the full-batch objective is the
+                    # SUM over examples, so accumulated grads are
+                    # already the full gradient and losses add
+                    loss = jnp.sum(ls)
+                    grads = acc_g
+                else:
+                    # mean-reduced: mean of equal-size microbatch means
+                    # == the full-batch mean
+                    loss = jnp.mean(ls)
+                    grads = jax.tree.map(lambda g: g / accum, acc_g)
             sparse_updates = {}
             if sparse_specs:
                 lr = self.optimizer.lr
@@ -710,10 +763,6 @@ class FFModel:
             new_params = {**frozen, **updates, **new_trainable,
                           **sparse_updates}
             return new_params, new_opt_state, loss, sums
-
-        per_ex_fn, loss_reduction = losses_mod.get_per_example_loss_fn(
-            self.loss_type)
-        self._loss_reduction = loss_reduction
 
         def eval_step(params, batch, nvalid):
             """Masked eval: only the first ``nvalid`` rows (padded tail
@@ -1016,6 +1065,13 @@ class FFModel:
 
     def train_batch(self, *arrays) -> float:
         """One fused train step; returns loss."""
+        accum = self.config.gradient_accumulation_steps
+        if accum > 1 and arrays and len(arrays[0]) % accum:
+            # fit(batch_size=...) can override the compile-time batch —
+            # fail here with the real reason, not a reshape trace error
+            raise ValueError(
+                f"batch of {len(arrays[0])} does not divide into "
+                f"gradient_accumulation_steps={accum} equal microbatches")
         batch = tuple(self._shard_batch(arrays))
         self._params, self._opt_state, loss, sums = self._train_step(
             self._params, self._opt_state, batch, self._step)

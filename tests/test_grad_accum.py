@@ -1,0 +1,136 @@
+"""Gradient accumulation (FFConfig.gradient_accumulation_steps):
+k microbatches scanned inside the one jitted step, one optimizer
+update.  Equal-size microbatches make the accumulated step numerically
+equivalent to the full-batch step — pinned here — while activation
+memory scales with the microbatch."""
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.parallel.mesh import MachineMesh
+
+
+def _model(accum, mesh_shape=None, batch=16):
+    cfg = ff.FFConfig(batch_size=batch, compute_dtype="float32")
+    cfg.gradient_accumulation_steps = accum
+    m = ff.FFModel(cfg, mesh=MachineMesh(mesh_shape or {"n": 1}))
+    x = m.create_tensor((batch, 12), name="x")
+    t = m.dense(x, 24, activation="relu")
+    t = m.dense(t, 5)
+    m.compile(ff.SGDOptimizer(lr=0.1, momentum=0.9), metrics=["accuracy"])
+    m.init_layers(seed=0)
+    return m
+
+
+def _data(batch=16):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((batch, 12)).astype(np.float32)
+    y = rng.integers(0, 5, (batch, 1)).astype(np.int32)
+    return x, y
+
+
+@pytest.mark.parametrize("accum", [2, 4])
+def test_accumulated_matches_full_batch(accum):
+    m1 = _model(1)
+    mk = _model(accum)
+    x, y = _data()
+    for _ in range(3):
+        l1 = float(m1.train_batch(x, y))
+        lk = float(mk.train_batch(x, y))
+        np.testing.assert_allclose(lk, l1, rtol=1e-5, atol=1e-6)
+    for k in m1._params:
+        np.testing.assert_allclose(
+            np.asarray(mk._params[k]), np.asarray(m1._params[k]),
+            rtol=1e-5, atol=1e-6, err_msg=k)
+
+
+def test_metric_sums_cover_full_batch():
+    m = _model(4)
+    x, y = _data()
+    m.train_batch(x, y)
+    sums = m._last_metric_sums
+    # accuracy sums count over the FULL batch, not one microbatch
+    assert int(sums["count"]) == 16
+
+
+def test_indivisible_batch_rejected():
+    cfg = ff.FFConfig(batch_size=10, compute_dtype="float32")
+    cfg.gradient_accumulation_steps = 4
+    m = ff.FFModel(cfg, mesh=MachineMesh({"n": 1}))
+    x = m.create_tensor((10, 4), name="x")
+    t = m.dense(x, 2)
+    with pytest.raises(ValueError, match="microbatch"):
+        m.compile(ff.SGDOptimizer(lr=0.1))
+
+
+def test_accum_on_mesh():
+    """Microbatches still shard over the dp mesh (16/2 = 8 over n=8)."""
+    _, l1 = None, None
+    m1 = _model(1, {"n": 8})
+    mk = _model(2, {"n": 8})
+    x, y = _data()
+    for _ in range(2):
+        l1 = float(m1.train_batch(x, y))
+        lk = float(mk.train_batch(x, y))
+    np.testing.assert_allclose(lk, l1, rtol=1e-4, atol=1e-5)
+
+
+def test_accum_disables_sparse_embedding_path():
+    cfg = ff.FFConfig(batch_size=8, compute_dtype="float32")
+    cfg.gradient_accumulation_steps = 2
+    m = ff.FFModel(cfg, mesh=MachineMesh({"n": 1}))
+    ids = m.create_tensor((8, 2), dtype="int32", name="ids")
+    t = m.embedding(ids, 40, 8, aggr="sum", name="emb")
+    t = m.dense(t, 1)
+    p = m.mse_loss(t, reduction="average")
+    m.compile(ff.SGDOptimizer(lr=0.1), metrics=[], final_tensor=p)
+    assert not m._sparse_embedding_specs()
+    m.init_layers(seed=0)
+    rng = np.random.default_rng(1)
+    ids_v = rng.integers(0, 40, (8, 2)).astype(np.int32)
+    y = rng.random((8, 1)).astype(np.float32)
+    losses = [float(m.train_batch(ids_v, y)) for _ in range(3)]
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
+def test_sum_reduced_loss_matches_full_batch():
+    """Sum-reduction (op-form MSE with reduction='sum' semantics is the
+    sum-reduce family): accumulated grads must NOT be divided by k and
+    losses must ADD — pinned against the full-batch step."""
+    def build(accum):
+        cfg = ff.FFConfig(batch_size=16, compute_dtype="float32")
+        cfg.gradient_accumulation_steps = accum
+        m = ff.FFModel(cfg, mesh=MachineMesh({"n": 1}))
+        x = m.create_tensor((16, 6), name="x")
+        t = m.dense(x, 8, activation="relu")
+        t = m.dense(t, 1)
+        p = m.mse_loss(t, reduction="sum")
+        m.compile(ff.SGDOptimizer(lr=0.01), metrics=[], final_tensor=p)
+        m.init_layers(seed=0)
+        return m
+
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((16, 6)).astype(np.float32)
+    y = rng.random((16, 1)).astype(np.float32)
+    m1, mk = build(1), build(4)
+    for _ in range(3):
+        l1 = float(m1.train_batch(x, y))
+        lk = float(mk.train_batch(x, y))
+        np.testing.assert_allclose(lk, l1, rtol=1e-5, atol=1e-6)
+    for k in m1._params:
+        np.testing.assert_allclose(
+            np.asarray(mk._params[k]), np.asarray(m1._params[k]),
+            rtol=1e-5, atol=1e-6, err_msg=k)
+
+
+def test_runtime_batch_override_rejected():
+    m = _model(4)
+    x, y = _data()
+    with pytest.raises(ValueError, match="microbatch"):
+        m.train_batch(x[:10], y[:10])
+
+
+def test_nonpositive_accum_rejected():
+    with pytest.raises(ValueError, match=">= 1"):
+        _model(0)
