@@ -651,7 +651,7 @@ class FFModel:
             aux = sum(ctx.aux_losses.values()) if ctx.aux_losses else 0.0
             return values[loss_uid], values[final_uid], ctx.updates, aux
 
-        def loss_and_metrics(trainable, frozen, batch, rng):
+        def loss_and_metrics(trainable, frozen, batch, rng, aux_scale=1.0):
             rows = {k[len(_ROWS):]: v for k, v in trainable.items()
                     if k.startswith(_ROWS)}
             params = {**frozen, **{k: v for k, v in trainable.items()
@@ -659,7 +659,11 @@ class FFModel:
             logits, preds, updates, aux = forward_full(
                 params, batch, rng, True, embedding_rows=rows or None)
             labels = batch[-1]
-            loss = loss_fn(logits, labels) + aux
+            # aux_scale: 1 normally; 1/k for sum-reduced gradient
+            # accumulation, where the k microbatch losses ADD — without
+            # the scale the (batch-size-free) aux terms would count k
+            # times in loss and gradients
+            loss = loss_fn(logits, labels) + aux * aux_scale
             sums = metrics_mod.compute_batch_metrics(
                 logits, labels, metric_names, loss_type)
             return loss, (updates, preds, sums)
@@ -697,10 +701,13 @@ class FFModel:
                     for a in batch)
                 zero_g = jax.tree.map(jnp.zeros_like, trainable)
 
+                aux_scale = 1.0 / accum if loss_reduction == "sum" else 1.0
+
                 def micro_body(acc_g, i):
                     mb = tuple(a[i] for a in micro)
                     (l, (upd, _lg, s)), g = grad_fn(
-                        trainable, frozen, mb, jax.random.fold_in(rng, i))
+                        trainable, frozen, mb, jax.random.fold_in(rng, i),
+                        aux_scale)
                     return jax.tree.map(jnp.add, acc_g, g), (l, s, upd)
 
                 acc_g, (ls, ss, upds) = jax.lax.scan(
@@ -896,12 +903,20 @@ class FFModel:
         # here so save/load agree on the on-disk name
         return path if path.endswith(".npz") else path + ".npz"
 
-    def save_checkpoint(self, path: str) -> None:
+    def save_checkpoint(self, path: str, async_write: bool = False) -> None:
         """Write params + optimizer state + step to one ``.npz``.  In
         multi-host runs every process participates in the gather, only
         process 0 writes the file, and all processes synchronize after the
         write so peers never read a partially written checkpoint from
-        shared storage."""
+        shared storage.
+
+        ``async_write=True`` overlaps the serialization with training:
+        the device->host GATHER stays synchronous (the live buffers may
+        be donated by the very next step), but the np.savez + atomic
+        rename — the slow disk half for multi-GB models — runs in a
+        background thread.  Single-process only (the multi-host barrier
+        must observe the completed write); a later save/load/exit joins
+        the pending writer first via :meth:`wait_for_checkpoint`."""
         flat: Dict[str, np.ndarray] = {}
         for k, v in self._params.items():
             flat[f"param:{k}"] = self._gather_host(v)
@@ -909,6 +924,7 @@ class FFModel:
         for i, leaf in enumerate(leaves):
             flat[f"opt:{i}"] = self._gather_host(leaf)
         flat["meta:step"] = np.asarray(self._step, np.int64)
+        self.wait_for_checkpoint()  # one writer at a time, in order
         if jax.process_index() == 0:
             # atomic publish: a crash/kill mid-save must never leave a
             # truncated file at the final name — a corrupt "newest"
@@ -918,11 +934,50 @@ class FFModel:
             # exactly there (it appends .npz to suffix-less paths).
             final = self._ckpt_path(path)
             tmp = final[:-len(".npz")] + ".tmp.npz"
-            np.savez(tmp, **flat)
-            os.replace(tmp, final)
+
+            def write():
+                np.savez(tmp, **flat)
+                os.replace(tmp, final)
+
+            if async_write and jax.process_count() == 1:
+                def guarded():
+                    try:
+                        write()
+                    except BaseException as e:
+                        # loud even if nothing ever joins (a script may
+                        # exit right after an async save): print the
+                        # traceback from the thread, AND store for
+                        # re-raise at the next save/load/wait
+                        import traceback
+                        traceback.print_exc()
+                        self._ckpt_exc = e
+
+                import threading
+                # non-daemon: the interpreter joins it at exit, so a
+                # script whose last act is an async save still publishes
+                self._ckpt_writer = threading.Thread(target=guarded)
+                self._ckpt_writer.start()
+            else:
+                write()  # sync path: failures raise directly, untouched
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("ff_checkpoint_written")
+
+    def _raise_ckpt_exc(self):
+        exc = getattr(self, "_ckpt_exc", None)
+        if exc is not None:
+            self._ckpt_exc = None
+            raise RuntimeError("checkpoint write failed") from exc
+
+    def wait_for_checkpoint(self) -> None:
+        """Join a pending async checkpoint writer; re-raises any write
+        failure (a silently missing checkpoint would roll training back
+        on the next restore)."""
+        w = getattr(self, "_ckpt_writer", None)
+        if w is not None:
+            w.join()
+            self._ckpt_writer = None
+        self._raise_ckpt_exc()
 
     def load_checkpoint(self, path: str) -> None:
         """Restore a checkpoint written by :meth:`save_checkpoint`,
@@ -930,6 +985,7 @@ class FFModel:
         Validates the full key set BEFORE mutating any state, so a graph or
         optimizer mismatch fails cleanly instead of half-restoring."""
         assert self._compiled, "call compile() + init_layers() first"
+        self.wait_for_checkpoint()  # never read under a pending writer
         with np.load(self._ckpt_path(path)) as f:
             ckpt_params = {k[len("param:"):] for k in f.files
                            if k.startswith("param:")}
@@ -1090,6 +1146,14 @@ class FFModel:
         cfg = self.config
         epochs = epochs or cfg.epochs
         bs = batch_size or cfg.batch_size
+        if cfg.gradient_accumulation_steps > 1 \
+                and bs % cfg.gradient_accumulation_steps:
+            # fit() feeds the jitted step directly — fail with the real
+            # reason, not a reshape trace error
+            raise ValueError(
+                f"fit batch_size {bs} does not divide into "
+                f"gradient_accumulation_steps="
+                f"{cfg.gradient_accumulation_steps} equal microbatches")
         xs = x if isinstance(x, (list, tuple)) else [x]
         callbacks = callbacks or []
         for cb in callbacks:

@@ -50,10 +50,22 @@ class Reshape(Op):
     def __init__(self, name, input_tensor, shape):
         super().__init__(name, [input_tensor])
         self._shape = tuple(int(s) for s in shape)
+        # a leading dim equal to the graph batch size is batch-RELATIVE:
+        # the runtime batch may differ (gradient-accumulation
+        # microbatches, fit(batch_size=...) overrides), so reshape
+        # preserves whatever leading dim arrives instead of baking the
+        # trace-time number in
+        self._batch_relative = (
+            len(self._shape) > 0
+            and input_tensor.num_dims > 0
+            and self._shape[0] == input_tensor.shape[0])
         self._add_output(self._shape, input_tensor.dtype)
 
     def forward(self, params, inputs, ctx):
-        return [inputs[0].reshape(self._shape)]
+        shape = self._shape
+        if self._batch_relative:
+            shape = (inputs[0].shape[0],) + shape[1:]
+        return [inputs[0].reshape(shape)]
 
     def flops(self):
         return 0

@@ -134,3 +134,38 @@ def test_runtime_batch_override_rejected():
 def test_nonpositive_accum_rejected():
     with pytest.raises(ValueError, match=">= 1"):
         _model(0)
+
+
+def test_fit_batch_override_rejected():
+    m = _model(4)
+    x, y = _data()
+    with pytest.raises(ValueError, match="microbatch"):
+        m.fit(x, y, batch_size=6, epochs=1)
+
+
+def test_sum_reduce_aux_losses_not_overcounted():
+    """MoE aux (load-balance) losses are batch-size-free; under
+    sum-reduced accumulation they must enter the objective once (the
+    microbatch MEAN), not k times — pinned against the full-batch step
+    within the variation the per-microbatch routing itself causes."""
+    def build(accum):
+        cfg = ff.FFConfig(batch_size=16, compute_dtype="float32")
+        cfg.gradient_accumulation_steps = accum
+        m = ff.FFModel(cfg, mesh=MachineMesh({"n": 1}))
+        x = m.create_tensor((16, 4, 8), name="x")  # MoE wants (n, s, d)
+        t = m.moe(x, num_experts=4, d_ff=16, k=1)
+        t = m.reshape(t, (16, 32))
+        t = m.dense(t, 1)
+        p = m.mse_loss(t, reduction="sum")
+        m.compile(ff.SGDOptimizer(lr=0.0), metrics=[], final_tensor=p)
+        m.init_layers(seed=0)
+        return m
+
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((16, 4, 8)).astype(np.float32)
+    y = rng.random((16, 1)).astype(np.float32)
+    l1 = float(build(1).train_batch(x, y))
+    lk = float(build(4).train_batch(x, y))
+    # without the 1/k aux scale this differs by ~3x the aux term;
+    # with it, only per-microbatch routing variation remains
+    assert abs(lk - l1) < 0.25 * abs(l1), (l1, lk)
