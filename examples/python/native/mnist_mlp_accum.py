@@ -1,0 +1,35 @@
+"""MNIST MLP trained with gradient accumulation: the effective batch
+stays `-b` while each jitted step scans `--accum-steps` equal
+microbatches and applies ONE optimizer update (activation memory scales
+with the microbatch — docs/performance.md).
+Run: flexflow-tpu mnist_mlp_accum.py -b 64 -e 2 --accum-steps 4"""
+
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.keras.datasets import mnist
+
+
+def top_level_task():
+    cfg = ff.get_default_config()
+    if cfg.gradient_accumulation_steps == 1:
+        cfg.gradient_accumulation_steps = 4
+    model = ff.FFModel(cfg)
+    x = model.create_tensor((cfg.batch_size, 784), name="input")
+    t = model.dense(x, 256, activation="relu")
+    t = model.dense(t, 256, activation="relu")
+    logits = model.dense(t, 10)
+    model.softmax(logits)
+    model.compile(ff.SGDOptimizer(lr=cfg.learning_rate),
+                  ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [ff.METRICS_ACCURACY], final_tensor=logits)
+    model.init_layers(seed=cfg.seed)
+
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 784).astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+    model.fit(x_train, y_train, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

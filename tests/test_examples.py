@@ -53,6 +53,7 @@ def _run_example(script, *extra, env=None, timeout=600):
 
 @pytest.mark.parametrize("script", [
     "examples/python/native/mnist_mlp.py",
+    "examples/python/native/mnist_mlp_accum.py",
     "examples/python/native/print_layers.py",
     "examples/python/native/mnist_mlp_attach.py",
     "examples/python/native/tensor_attach.py",
