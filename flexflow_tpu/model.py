@@ -402,12 +402,7 @@ class FFModel:
             raise ValueError(
                 f"gradient_accumulation_steps must be >= 1, got "
                 f"{cfg.gradient_accumulation_steps}")
-        if cfg.gradient_accumulation_steps > 1 \
-                and cfg.batch_size % cfg.gradient_accumulation_steps:
-            raise ValueError(
-                f"batch_size {cfg.batch_size} must divide into "
-                f"gradient_accumulation_steps="
-                f"{cfg.gradient_accumulation_steps} equal microbatches")
+        self._check_accum_divisible(cfg.batch_size, "batch_size")
         self._resolve_host_placements()
         self._build_step_fns()
         self._compiled = True
@@ -1119,15 +1114,20 @@ class FFModel:
         self._train_step.lower(self._params, self._opt_state, batch,
                                self._step).compile()
 
+    def _check_accum_divisible(self, n: int, what: str) -> None:
+        """Every entry point that feeds the jitted step validates its
+        batch here — the scan reshape inside would otherwise fail with
+        an opaque trace error."""
+        accum = self.config.gradient_accumulation_steps
+        if accum > 1 and n % accum:
+            raise ValueError(
+                f"{what} {n} does not divide into "
+                f"gradient_accumulation_steps={accum} equal microbatches")
+
     def train_batch(self, *arrays) -> float:
         """One fused train step; returns loss."""
-        accum = self.config.gradient_accumulation_steps
-        if accum > 1 and arrays and len(arrays[0]) % accum:
-            # fit(batch_size=...) can override the compile-time batch —
-            # fail here with the real reason, not a reshape trace error
-            raise ValueError(
-                f"batch of {len(arrays[0])} does not divide into "
-                f"gradient_accumulation_steps={accum} equal microbatches")
+        if arrays:
+            self._check_accum_divisible(len(arrays[0]), "batch of")
         batch = tuple(self._shard_batch(arrays))
         self._params, self._opt_state, loss, sums = self._train_step(
             self._params, self._opt_state, batch, self._step)
@@ -1146,14 +1146,7 @@ class FFModel:
         cfg = self.config
         epochs = epochs or cfg.epochs
         bs = batch_size or cfg.batch_size
-        if cfg.gradient_accumulation_steps > 1 \
-                and bs % cfg.gradient_accumulation_steps:
-            # fit() feeds the jitted step directly — fail with the real
-            # reason, not a reshape trace error
-            raise ValueError(
-                f"fit batch_size {bs} does not divide into "
-                f"gradient_accumulation_steps="
-                f"{cfg.gradient_accumulation_steps} equal microbatches")
+        self._check_accum_divisible(bs, "fit batch_size")
         xs = x if isinstance(x, (list, tuple)) else [x]
         callbacks = callbacks or []
         for cb in callbacks:
