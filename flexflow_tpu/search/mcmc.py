@@ -190,7 +190,7 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
            verbose: bool = False, flash_attention=_UNSET,
            devices_per_slice=_UNSET, remat=_UNSET,
            compute_dtype=_UNSET, conv_layout=_UNSET,
-           opt_slot_bytes=_UNSET,
+           opt_slot_bytes=_UNSET, sparse_tables=_UNSET,
            sim: Optional[Simulator] = None
            ) -> Tuple[Dict[str, ParallelConfig], MeshShape, float]:
     """Run the annealing loop; returns (best strategies, best mesh
@@ -209,7 +209,8 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
                ("devices_per_slice", devices_per_slice),
                ("compute_dtype", compute_dtype),
                ("conv_layout", conv_layout),
-               ("opt_slot_bytes", opt_slot_bytes))
+               ("opt_slot_bytes", opt_slot_bytes),
+               ("sparse_tables", sparse_tables))
     if sim is not None:
         # the shared sim's config IS the objective; contradicting kwargs
         # would silently split seed-ranking from the acceptance test
@@ -227,7 +228,8 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
         # Simulator.__init__ applies — raw-kwarg comparison would warn
         # on agreeing calls
         _norm = {"spec": lambda v: spec_for_device() if v is None else v,
-                 "devices_per_slice": lambda v: v or num_devices}
+                 "devices_per_slice": lambda v: v or num_devices,
+                 "sparse_tables": lambda v: frozenset(v or ())}
         for _name, _given in _kwargs:
             if _given is _UNSET:
                 continue
@@ -290,7 +292,8 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
         spec=spec, num_devices=num_devices,
         devices_per_slice=devices_per_slice, remat=remat,
         flash_attention=flash_attention, compute_dtype=compute_dtype,
-        conv_layout=conv_layout, opt_slot_bytes=opt_slot_bytes)
+        conv_layout=conv_layout, opt_slot_bytes=opt_slot_bytes,
+        sparse_tables=sim.sparse_tables)
     seed_cache: Dict[Tuple[int, ...], List] = {}
 
     def mesh_seeds(ms: MeshShape) -> List:
@@ -383,6 +386,9 @@ def optimize_strategies(model, cfg: FFConfig) -> Dict[str, ParallelConfig]:
     # in the layout the run will actually use
     from ..op import resolve_conv_layout
     layout = resolve_conv_layout(cfg.conv_layout, model.layers)
+    # tables on the sparse-update path sync row grads, not the table —
+    # the objective must cost what the run will actually move
+    sparse_tables = {t for _, t, _ in model._sparse_embedding_specs()}
     best, best_mesh, best_time = search(
         model.layers, ndev, budget=cfg.search_budget,
         alpha=cfg.search_alpha, seed=cfg.seed,
@@ -391,7 +397,7 @@ def optimize_strategies(model, cfg: FFConfig) -> Dict[str, ParallelConfig]:
         flash_attention=cfg.flash_attention,
         devices_per_slice=dps, remat=cfg.remat,
         compute_dtype=cfg.compute_dtype, conv_layout=layout,
-        opt_slot_bytes=slot_bytes)
+        opt_slot_bytes=slot_bytes, sparse_tables=sparse_tables)
     print(f"[search] best simulated iteration time: {best_time * 1e3:.3f} ms "
           f"on {ndev} devices, mesh "
           f"{ {a: s for a, s in best_mesh.items() if s > 1} }")

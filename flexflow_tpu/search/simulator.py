@@ -79,7 +79,8 @@ class Simulator:
                  measure: bool = False, dtype_bytes: int = 2,
                  use_native: bool = True, flash_attention=None,
                  remat: bool = False, compute_dtype: str = "bfloat16",
-                 conv_layout: str = "auto", opt_slot_bytes: int = 4):
+                 conv_layout: str = "auto", opt_slot_bytes: int = 4,
+                 sparse_tables=None):
         self.spec = spec if spec is not None else spec_for_device()
         self.num_devices = num_devices
         self.devices_per_slice = devices_per_slice or num_devices
@@ -90,6 +91,11 @@ class Simulator:
         # under-counted Adam by 4 B/param when this was hardcoded
         # (VERDICT r4 weak #2)
         self.opt_slot_bytes = opt_slot_bytes
+        # embedding tables on the run's sparse-update path
+        # (FFModel._sparse_embedding_specs): their replica sync moves only
+        # the touched ROW gradients, not the table — dense-path costing
+        # would overestimate DLRM/NMT-class sync by orders of magnitude
+        self.sparse_tables = frozenset(sparse_tables or ())
         self.flash_attention = flash_attention  # measure the run's kernels
         self.remat = remat  # the run rematerializes: less resident memory
         self.compute_dtype = compute_dtype  # measure the run's dtype
@@ -201,6 +207,10 @@ class Simulator:
                 if not w.trainable:
                     continue
                 wb = w.volume * 4
+                if w.name in self.sparse_tables:
+                    # sparse-update table: replicas exchange the touched
+                    # row grads (ids x row width), never the full table
+                    wb = op.inputs[0].volume * w.shape[-1] * 4
                 if (w.sharded_dim is not None and c_deg > 1
                         and w.shape[w.sharded_dim] % c_deg == 0):
                     sync += allreduce_time(

@@ -47,6 +47,9 @@ from tests.subproc import CACHE_DIR, CACHE_DIR_IS_DEFAULT  # noqa: E402
 # shared with other projects and must never be rmtree'd
 if CACHE_DIR_IS_DEFAULT and not os.environ.get("FF_TEST_KEEP_CACHE"):
     shutil.rmtree(CACHE_DIR, ignore_errors=True)
+    # recreate: jax does not reliably mkdir on a cache WRITE, so a
+    # missing dir turns every entry write into a UserWarning
+    os.makedirs(CACHE_DIR, exist_ok=True)
 jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
 # min 1s: cache the model-step compiles that dominate, not thousands of
 # tiny jits — fewer writes, fewer chances for a killed process to leave
