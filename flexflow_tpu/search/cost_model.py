@@ -134,7 +134,8 @@ def op_memory_bytes(op: Op, part_degrees: Tuple[int, ...],
                     axes: Tuple[str, ...] = (),
                     stack_degrees: Dict[str, int] | None = None,
                     remat: bool = False,
-                    act_scale: float | None = None) -> float:
+                    act_scale: float | None = None,
+                    sparse_tables=frozenset()) -> float:
     """Per-chip resident bytes one op contributes to the training step's
     high-water mark (reference: the simulator allocates its scratch from
     real FB memory, simulator.cu:82-88, so unfittable strategies are
@@ -172,7 +173,14 @@ def op_memory_bytes(op: Op, part_degrees: Tuple[int, ...],
         nparts *= d
     total = 0.0
     for w in op.weights:
-        per_param = w.volume * (4.0 * 2 + opt_slot_bytes)  # + grad + slots
+        if w.name in sparse_tables:
+            # sparse-update table (FFModel._sparse_embedding_specs): no
+            # table-shaped gradient ever materializes (row grads are
+            # activation-sized) and plain SGD — the eligibility
+            # condition — keeps no slots; only the params reside
+            per_param = w.volume * 4.0
+        else:
+            per_param = w.volume * (4.0 * 2 + opt_slot_bytes)  # +grad+slots
         stack_ax = getattr(w, "shard_axis", "c")
         if stack_ax in ("e", "p") and w.sharded_dim is not None:
             deg = stack_degrees.get(stack_ax, 1)

@@ -261,7 +261,8 @@ class Simulator:
                                      opt_slot_bytes=self.opt_slot_bytes,
                                      axes=dim_axis_names(out.num_dims),
                                      stack_degrees=stack, remat=remat,
-                                     act_scale=act_scale)
+                                     act_scale=act_scale,
+                                     sparse_tables=self.sparse_tables)
         return total
 
     def _simulate_native(self, layers: List[Op],

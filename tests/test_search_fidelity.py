@@ -255,3 +255,27 @@ def test_sparse_table_sync_costs_rows_not_table():
     assert sync_sparse > 0
     # table 100k x 64 f32 = 25.6 MB vs rows 64 x 64 x 4 = 16 KB
     assert sync_dense / sync_sparse > 50, (sync_dense, sync_sparse)
+
+
+def test_sparse_table_memory_excludes_dense_grad():
+    """HBM legality: a sparse-update table resides as params ONLY — the
+    dense path's table-shaped gradient (+ slots) never materializes, so
+    big-table strategies must not be falsely inf'd."""
+    from flexflow_tpu.search.cost_model import op_memory_bytes
+
+    ids = Tensor((64, 1), "int32", name="ids")
+    emb = Embedding("emb", ids, 1000000, 64)
+    table = emb.w_table.name
+    dense = op_memory_bytes(emb, (4, 1), opt_slot_bytes=0)
+    sparse = op_memory_bytes(emb, (4, 1), opt_slot_bytes=0,
+                             sparse_tables={table})
+    # dense charges params+grads (8 B/param); sparse params only (4)
+    assert dense > 1.9 * sparse, (dense, sparse)
+
+    s_dense = Simulator(num_devices=4, use_native=False)
+    s_sparse = Simulator(num_devices=4, use_native=False,
+                         sparse_tables={table})
+    pc = {"emb": ParallelConfig.data_parallel(4, 2)}
+    m_dense = s_dense.peak_memory_bytes([emb], pc)
+    m_sparse = s_sparse.peak_memory_bytes([emb], pc)
+    assert m_dense > m_sparse > 0
