@@ -48,11 +48,25 @@ def build_model(shape: str):
         t = model.reshape(model.split(t, [1, 7], axis=1)[0], (BATCH, 16))
         t = model.dense(t, 4, name="head")
         feed = "tokens"
+    elif shape == "dp8sparse":
+        # plain SGD puts the embedding on the SPARSE-update path
+        # (rows-autodiff + scatter-add) — this shape pins it across
+        # PROCESS boundaries, where the row-grad exchange rides gloo
+        cfg = ff.FFConfig(batch_size=BATCH, compute_dtype="float32")
+        model = ff.FFModel(cfg, mesh=ff.MachineMesh({"n": 8}))
+        tok = model.create_tensor((BATCH, 4), dtype="int32", name="tokens")
+        t = model.embedding(tok, 64, 16, aggr="sum", name="emb0")
+        t = model.dense(t, 16, activation="relu", name="fc1")
+        t = model.dense(t, 4, name="fc2")
+        feed = "tokens4"
     else:
         raise ValueError(f"unknown shape {shape!r}")
-    model.compile(ff.SGDOptimizer(lr=0.1, momentum=0.9),
-                  ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY, ["accuracy"],
-                  final_tensor=t)
+    opt = (ff.SGDOptimizer(lr=0.1) if shape == "dp8sparse"
+           else ff.SGDOptimizer(lr=0.1, momentum=0.9))
+    model.compile(opt, ff.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  ["accuracy"], final_tensor=t)
+    if shape == "dp8sparse":
+        assert model._sparse_embedding_specs(), "sparse path must engage"
     model.init_layers(seed=0)
     return model, feed
 
@@ -60,7 +74,9 @@ def build_model(shape: str):
 def make_batch(feed: str):
     import numpy as np
     rng = np.random.default_rng(0)  # same feed on every process (SPMD)
-    if feed == "tokens":
+    if feed == "tokens4":
+        xd = rng.integers(0, 64, (BATCH, 4)).astype(np.int32)
+    elif feed == "tokens":
         xd = rng.integers(0, 32, (BATCH, 8)).astype(np.int32)
     else:
         xd = rng.standard_normal((BATCH, 16)).astype(np.float32)

@@ -138,3 +138,15 @@ def test_four_process_pipeline_mesh_trains_and_resumes(tmp_path):
     loss = _check(losses)
     ref_loss = _single_process_reference("dp2tp2pp2")
     assert abs(ref_loss - loss) < 1e-4, (ref_loss, loss)
+
+
+@pytest.mark.slow
+def test_two_process_sparse_embedding_mesh(tmp_path):
+    """Sparse embedding updates across PROCESS boundaries: the row-grad
+    exchange and replicated-table scatter ride the multi-process
+    runtime; loss matches the single-process run and checkpoint resume
+    reproduces the post-save step (dp8 over 2 procs x 4 devices)."""
+    losses = _run_workers(2, 4, "dp8sparse", tmp_path, timeout=420)
+    loss = _check(losses)
+    ref_loss = _single_process_reference("dp8sparse")
+    assert abs(ref_loss - loss) < 1e-4, (ref_loss, loss)
