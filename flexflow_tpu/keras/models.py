@@ -130,14 +130,24 @@ class BaseModel:
         self._compiled = True
 
     def fit(self, x=None, y=None, batch_size=None, epochs=1, verbose=1,
-            callbacks=None, **kwargs):
-        for k, dflt in (("validation_split", 0.0), ("validation_data", None),
-                        ("class_weight", None), ("sample_weight", None),
+            callbacks=None, validation_data=None, validation_split=0.0,
+            **kwargs):
+        for k, dflt in (("class_weight", None), ("sample_weight", None),
                         ("initial_epoch", 0), ("steps_per_epoch", None)):
             assert kwargs.pop(k, dflt) == dflt, f"{k} is not supported"
         assert self._compiled, "compile() first"
+        if validation_split and validation_data is None:
+            # keras semantics: the LAST fraction of the data, un-shuffled
+            xs = x if isinstance(x, (list, tuple)) else [x]
+            n = xs[0].shape[0]
+            cut = max(1, int(n * (1.0 - float(validation_split))))
+            validation_data = ([a[cut:] for a in xs], y[cut:])
+            x = [a[:cut] for a in xs] if isinstance(x, (list, tuple)) \
+                else xs[0][:cut]
+            y = y[:cut]
         return self.ffmodel.fit(x, y, epochs=epochs, batch_size=batch_size,
-                                callbacks=callbacks, verbose=bool(verbose))
+                                callbacks=callbacks, verbose=bool(verbose),
+                                validation_data=validation_data)
 
     def evaluate(self, x, y, batch_size=None):
         return self.ffmodel.evaluate(x, y, batch_size=batch_size)

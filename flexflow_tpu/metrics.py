@@ -69,6 +69,9 @@ class PerfMetrics:
     rmse_loss: float = 0.0
     mae_loss: float = 0.0
     has_accuracy: bool = False  # accuracy metric enabled (vs value 0)
+    # fit(validation_data=...) REPLACES this with the epoch's
+    # val_loss/val_<metric> dict; callbacks watch it for early stopping
+    val_scalars: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     def update(self, batch_sums: Dict[str, jax.Array]) -> None:
         self.train_all += int(batch_sums.get("count", 0))

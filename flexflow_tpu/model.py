@@ -1139,14 +1139,27 @@ class FFModel:
 
     def fit(self, x, y, epochs: Optional[int] = None,
             batch_size: Optional[int] = None, callbacks=None,
-            verbose: bool = True):
+            verbose: bool = True, validation_data=None):
         """Epoch loop (reference keras BaseModel.fit / alexnet.cc:102-118).
         Prints the reference's end-of-run throughput line
-        (alexnet.cc:129-130)."""
+        (alexnet.cc:129-130).  ``validation_data=(x_val, y_val)`` runs a
+        masked evaluate() after every epoch; val_loss and val_<metric>s
+        join the JSON epoch event, the human line, and the
+        ``PerfMetrics`` handed to callbacks (keras-style early stopping
+        can watch them)."""
         cfg = self.config
         epochs = epochs or cfg.epochs
         bs = batch_size or cfg.batch_size
         self._check_accum_divisible(bs, "fit batch_size")
+        if validation_data is not None:
+            if not isinstance(validation_data, (tuple, list)) \
+                    or len(validation_data) != 2:
+                raise ValueError(
+                    "validation_data must be a (x_val, y_val) pair"
+                    + ("; per-sample validation weights (the keras "
+                       "3-tuple) are not supported"
+                       if isinstance(validation_data, (tuple, list))
+                       and len(validation_data) == 3 else ""))
         xs = x if isinstance(x, (list, tuple)) else [x]
         callbacks = callbacks or []
         for cb in callbacks:
@@ -1164,6 +1177,7 @@ class FFModel:
         loader = PrefetchLoader(self, xs, y, batch_size=bs)
         t_start = time.time()
         total_samples = 0
+        val_time = 0.0
         with tracer:
             for epoch in range(epochs):
                 for cb in callbacks:
@@ -1183,6 +1197,21 @@ class FFModel:
                     epoch_sums.append(sums)
                 for sums in jax.device_get(epoch_sums):
                     self.perf_metrics.update(sums)
+                val_scalars: Dict[str, float] = {}
+                if validation_data is not None:
+                    xv, yv = validation_data
+                    t_val0 = time.time()
+                    val_loss, val_pm = self.evaluate(xv, yv, batch_size=bs)
+                    # validation (incl. the one-time _eval_step compile)
+                    # must not skew the reference-parity THROUGHPUT line
+                    val_time += time.time() - t_val0
+                    val_scalars = {"val_loss": float(val_loss)}
+                    val_scalars.update(
+                        {f"val_{k}": float(v)
+                         for k, v in val_pm.scalars().items()
+                         if k != "samples_seen"})
+                    # callbacks watch these (keras-style early stopping)
+                    self.perf_metrics.val_scalars = val_scalars
                 # structured per-epoch record (one parseable JSON line; the
                 # reference only had printf metrics — SURVEY §5 observability)
                 from .fflogger import get_logger
@@ -1191,7 +1220,8 @@ class FFModel:
                     samples=total_samples,
                     elapsed_s=round(time.time() - t_start, 3),
                     **{k: round(float(v), 6)
-                       for k, v in self.perf_metrics.scalars().items()})
+                       for k, v in {**self.perf_metrics.scalars(),
+                                    **val_scalars}.items()})
                 for cb in callbacks:
                     cb.on_epoch_end(epoch, self.perf_metrics)
                 stopping = any(getattr(cb, "stop_training", False)
@@ -1201,16 +1231,23 @@ class FFModel:
                 # always print
                 if verbose and (epoch % cfg.print_frequency == 0
                                 or epoch == epochs - 1 or stopping):
-                    print(f"epoch {epoch}: "
-                          f"{self.perf_metrics.report(self.metrics or [self.loss_type])}")
+                    line = (f"epoch {epoch}: "
+                            f"{self.perf_metrics.report(self.metrics or [self.loss_type])}")
+                    if val_scalars:
+                        line += " — " + ", ".join(
+                            f"{k}: {v:.6g}" for k, v in val_scalars.items())
+                    print(line)
                 if stopping:
                     break
             jax.block_until_ready(self._params)
         elapsed = time.time() - t_start
+        train_elapsed = max(1e-9, elapsed - val_time)
         if verbose and elapsed > 0:
-            # reference alexnet.cc:129-130 throughput line
-            print(f"ELAPSED TIME = {elapsed:.4f}s, "
-                  f"THROUGHPUT = {total_samples / elapsed:.2f} samples/s")
+            # reference alexnet.cc:129-130 throughput line — TRAINING
+            # time only (per-epoch validation is excluded)
+            print(f"ELAPSED TIME = {train_elapsed:.4f}s, "
+                  f"THROUGHPUT = {total_samples / train_elapsed:.2f} "
+                  f"samples/s")
         for cb in callbacks:
             cb.on_train_end()
         return self.perf_metrics

@@ -1,0 +1,66 @@
+"""fit(validation_data=...) — per-epoch masked evaluation whose
+val_loss/val_<metric> scalars join the epoch event, the human line, and
+the PerfMetrics handed to callbacks; keras fit adds validation_split
+with keras semantics (last fraction, un-shuffled)."""
+
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.parallel.mesh import MachineMesh
+
+
+def _model():
+    cfg = ff.FFConfig(batch_size=16, epochs=2, compute_dtype="float32")
+    m = ff.FFModel(cfg, mesh=MachineMesh({"n": 4}))
+    x = m.create_tensor((16, 8), name="x")
+    t = m.dense(x, 16, activation="relu")
+    t = m.dense(t, 3)
+    m.compile(ff.SGDOptimizer(lr=0.1), metrics=["accuracy"])
+    m.init_layers(seed=0)
+    return m
+
+
+def _data(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, 8)).astype(np.float32)
+    y = rng.integers(0, 3, (n, 1)).astype(np.int32)
+    return x, y
+
+
+def test_core_fit_validation_data():
+    m = _model()
+    x, y = _data()
+    xv, yv = _data(32, seed=1)
+    pm = m.fit(x, y, validation_data=(xv, yv), verbose=False)
+    vs = pm.val_scalars
+    assert set(vs) >= {"val_loss", "val_accuracy"}, vs
+    assert np.isfinite(vs["val_loss"]) and 0.0 <= vs["val_accuracy"] <= 1.0
+    # the reported val numbers ARE evaluate()'s numbers
+    loss, vpm = m.evaluate(xv, yv)
+    np.testing.assert_allclose(vs["val_loss"], loss, rtol=1e-6)
+    np.testing.assert_allclose(vs["val_accuracy"], vpm.accuracy, rtol=1e-6)
+
+
+def test_keras_validation_split():
+    from flexflow_tpu import keras
+
+    model = keras.Sequential([
+        keras.layers.Dense(16, activation="relu", input_shape=(8,)),
+        keras.layers.Dense(3),
+    ])
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    x, y = _data(80)
+    pm = model.fit(x, y, batch_size=16, epochs=1, verbose=0,
+                   validation_split=0.2)
+    assert "val_loss" in pm.val_scalars
+    # split is the LAST 20%, un-shuffled: training saw only the first 64
+    assert pm.train_all == 64
+
+
+def test_validation_data_3tuple_rejected():
+    import pytest
+    m = _model()
+    x, y = _data()
+    with pytest.raises(ValueError, match="3-tuple"):
+        m.fit(x, y, validation_data=(x, y, np.ones(64)), verbose=False)
