@@ -4,7 +4,8 @@ layer/optimizer/callback surfaces, and the accuracy-verification callbacks
 the reference's example suite uses as its test harness."""
 
 from . import callbacks, datasets, layers, optimizers, preprocessing
-from .callbacks import (Callback, EpochVerifyMetrics, LearningRateScheduler,
+from .callbacks import (Callback, EarlyStopping, EpochVerifyMetrics,
+                        LearningRateScheduler,
                         ModelAccuracy, VerifyMetrics)
 from .layers import (Activation, Add, AveragePooling2D, BatchNormalization,
                      Concatenate, Conv2D, Dense, Dropout, Embedding, Flatten,

@@ -9,6 +9,8 @@ from __future__ import annotations
 import enum
 from typing import Optional
 
+import numpy as np
+
 
 class ModelAccuracy(enum.Enum):
     """Per-model accuracy bounds (reference
@@ -64,6 +66,81 @@ class LearningRateScheduler(Callback):
         # the new hyperparameter
         self.model._build_step_fns()
         print("set learning rate ", lr)
+
+
+class EarlyStopping(Callback):
+    """keras-style early stopping: watches a monitored metric (default
+    ``val_loss``, from ``fit(validation_data=...)``; any key of
+    ``PerfMetrics.scalars()`` or ``.val_scalars`` works) and sets
+    ``stop_training`` after ``patience`` epochs without ``min_delta``
+    improvement.  ``restore_best_weights`` reloads the best epoch's
+    params (captured host-side at each improvement)."""
+
+    def __init__(self, monitor="val_loss", min_delta=0.0, patience=0,
+                 mode="auto", restore_best_weights=False):
+        super().__init__()
+        self.monitor = monitor
+        self.min_delta = float(min_delta)
+        self.patience = int(patience)
+        self.restore_best_weights = bool(restore_best_weights)
+        if mode not in ("auto", "min", "max"):
+            raise ValueError(f"mode must be auto|min|max, got {mode!r}")
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.stop_training = False
+        self.best = None
+        self.wait = 0
+        self._best_params = None
+
+    def on_train_begin(self, logs=None):
+        # a reused instance must not carry a previous fit's verdict
+        # (keras resets the same state here)
+        self.stop_training = False
+        self.best = None
+        self.wait = 0
+        self._best_params = None
+
+    def _value(self, pm):
+        scalars = {**pm.scalars(), **getattr(pm, "val_scalars", {})}
+        if self.monitor not in scalars:
+            raise KeyError(
+                f"EarlyStopping monitors {self.monitor!r} but this "
+                f"epoch reported {sorted(scalars)} — pass "
+                f"validation_data to fit() for val_* metrics")
+        return float(scalars[self.monitor])
+
+    def _improved(self, value):
+        if self.best is None:
+            return True
+        if self.mode == "min":
+            return value < self.best - self.min_delta
+        return value > self.best + self.min_delta
+
+    def on_epoch_end(self, epoch, logs=None):
+        value = self._value(logs)
+        if self._improved(value):
+            self.best = value
+            self.wait = 0
+            if self.restore_best_weights:
+                # _gather_host handles non-addressable shards in
+                # multi-process runs (device_get would raise there)
+                self._best_params = {
+                    k: self.model._gather_host(v)
+                    for k, v in self.model._params.items()}
+            return
+        self.wait += 1
+        if self.wait >= max(1, self.patience):
+            self.stop_training = True
+            print(f"early stopping: {self.monitor} did not improve past "
+                  f"{self.best:.6g} for {self.wait} epochs")
+
+    def on_train_end(self, logs=None):
+        if self.restore_best_weights and self._best_params is not None:
+            m = self.model
+            m._params = {
+                k: m._put_global(np.asarray(v), m._params[k].sharding)
+                for k, v in self._best_params.items()}
 
 
 class VerifyMetrics(Callback):

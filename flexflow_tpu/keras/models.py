@@ -137,6 +137,10 @@ class BaseModel:
             assert kwargs.pop(k, dflt) == dflt, f"{k} is not supported"
         assert self._compiled, "compile() first"
         if validation_split and validation_data is None:
+            if not 0.0 < float(validation_split) < 1.0:
+                raise ValueError(
+                    f"validation_split must be in (0, 1), got "
+                    f"{validation_split!r}")
             # keras semantics: the LAST fraction of the data, un-shuffled
             xs = x if isinstance(x, (list, tuple)) else [x]
             n = xs[0].shape[0]

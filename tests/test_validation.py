@@ -64,3 +64,33 @@ def test_validation_data_3tuple_rejected():
     x, y = _data()
     with pytest.raises(ValueError, match="3-tuple"):
         m.fit(x, y, validation_data=(x, y, np.ones(64)), verbose=False)
+
+
+def test_early_stopping_on_val_loss():
+    """EarlyStopping watches val_loss and halts fit; with
+    restore_best_weights the best epoch's params come back."""
+    from flexflow_tpu.keras import EarlyStopping
+
+    m = _model()
+    x, y = _data()
+    # tiny validation set the model can't fit: val_loss plateaus fast
+    xv, yv = _data(16, seed=9)
+    cb = EarlyStopping(monitor="val_loss", patience=1,
+                       restore_best_weights=True)
+    m.fit(x, y, epochs=30, validation_data=(xv, yv), callbacks=[cb],
+          verbose=False)
+    assert cb.stop_training, "should stop before 30 epochs on plateau"
+    assert cb.best is not None
+    # restored params reproduce the best val_loss
+    loss, _ = m.evaluate(xv, yv)
+    np.testing.assert_allclose(loss, cb.best, rtol=1e-5, atol=1e-6)
+
+
+def test_early_stopping_unknown_monitor_loud():
+    import pytest
+    from flexflow_tpu.keras import EarlyStopping
+
+    m = _model()
+    x, y = _data()
+    with pytest.raises(KeyError, match="validation_data"):
+        m.fit(x, y, epochs=2, callbacks=[EarlyStopping()], verbose=False)
