@@ -5,8 +5,8 @@ the reference's example suite uses as its test harness."""
 
 from . import callbacks, datasets, layers, optimizers, preprocessing
 from .callbacks import (Callback, EarlyStopping, EpochVerifyMetrics,
-                        LearningRateScheduler,
-                        ModelAccuracy, VerifyMetrics)
+                        LearningRateScheduler, ModelAccuracy,
+                        ModelCheckpoint, VerifyMetrics)
 from .layers import (Activation, Add, AveragePooling2D, BatchNormalization,
                      Concatenate, Conv2D, Dense, Dropout, Embedding, Flatten,
                      Input, InputLayer, LayerNormalization, MaxPooling2D,

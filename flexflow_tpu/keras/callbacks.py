@@ -143,6 +143,53 @@ class EarlyStopping(Callback):
                 for k, v in self._best_params.items()}
 
 
+class ModelCheckpoint(Callback):
+    """keras-style checkpointing on FFModel's sharded .npz format:
+    saves after each epoch — or only on improvement of ``monitor``
+    (``save_best_only``) — via ``save_checkpoint``; ``async_write``
+    (default) overlaps serialization with the next epoch.  ``filepath``
+    may contain ``{epoch}`` and any reported scalar
+    (``{val_loss:.4f}``, ...)."""
+
+    def __init__(self, filepath, monitor="val_loss", save_best_only=False,
+                 mode="auto", async_write=True, verbose=0):
+        super().__init__()
+        self.filepath = str(filepath)
+        self.monitor = monitor
+        self.save_best_only = bool(save_best_only)
+        self.async_write = bool(async_write)
+        self.verbose = verbose
+        if mode not in ("auto", "min", "max"):
+            raise ValueError(f"mode must be auto|min|max, got {mode!r}")
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.best = None
+
+    def on_train_begin(self, logs=None):
+        self.best = None  # reused instances track the new run
+
+    def on_epoch_end(self, epoch, logs=None):
+        scalars = {**logs.scalars(), **getattr(logs, "val_scalars", {})}
+        if self.save_best_only:
+            if self.monitor not in scalars:
+                raise KeyError(
+                    f"ModelCheckpoint monitors {self.monitor!r} but this "
+                    f"epoch reported {sorted(scalars)} — pass "
+                    f"validation_data to fit() for val_* metrics")
+            value = float(scalars[self.monitor])
+            improved = (self.best is None
+                        or (value < self.best if self.mode == "min"
+                            else value > self.best))
+            if not improved:
+                return
+            self.best = value
+        path = self.filepath.format(epoch=epoch, **scalars)
+        self.model.save_checkpoint(path, async_write=self.async_write)
+        if self.verbose:
+            print(f"saved checkpoint {path}")
+
+
 class VerifyMetrics(Callback):
     """Asserts the final training accuracy beats the per-model bound
     (reference callbacks.py:64-72)."""

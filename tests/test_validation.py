@@ -94,3 +94,36 @@ def test_early_stopping_unknown_monitor_loud():
     x, y = _data()
     with pytest.raises(KeyError, match="validation_data"):
         m.fit(x, y, epochs=2, callbacks=[EarlyStopping()], verbose=False)
+
+
+def test_model_checkpoint_save_best_only(tmp_path):
+    """ModelCheckpoint(save_best_only) writes only on improvement; the
+    newest file restores to the best epoch's exact state."""
+    from flexflow_tpu.keras import ModelCheckpoint
+
+    m = _model()
+    x, y = _data()
+    xv, yv = _data(16, seed=9)
+    path = str(tmp_path / "best_e{epoch}")
+    cb = ModelCheckpoint(path, monitor="val_loss", save_best_only=True,
+                         async_write=True)
+    m.fit(x, y, epochs=6, validation_data=(xv, yv), callbacks=[cb],
+          verbose=False)
+    m.wait_for_checkpoint()
+    saved = sorted(tmp_path.glob("best_e*.npz"),
+                   key=lambda p: int(p.stem.split("e")[-1]))
+    assert saved, "at least epoch 0 must be saved"
+    assert len(saved) <= 6
+    m.load_checkpoint(str(saved[-1]))
+    loss, _ = m.evaluate(xv, yv)
+    np.testing.assert_allclose(loss, cb.best, rtol=1e-5, atol=1e-6)
+
+
+def test_model_checkpoint_every_epoch(tmp_path):
+    from flexflow_tpu.keras import ModelCheckpoint
+
+    m = _model()
+    x, y = _data()
+    cb = ModelCheckpoint(str(tmp_path / "ck_e{epoch}"), async_write=False)
+    m.fit(x, y, epochs=3, callbacks=[cb], verbose=False)
+    assert len(list(tmp_path.glob("ck_e*.npz"))) == 3
