@@ -10,6 +10,7 @@ reference's callback protocol (``_train``, base_model.py:194-251).
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Sequence
 
 from .. import losses as core_losses
@@ -152,6 +153,68 @@ class BaseModel:
         return self.ffmodel.fit(x, y, epochs=epochs, batch_size=batch_size,
                                 callbacks=callbacks, verbose=bool(verbose),
                                 validation_data=validation_data)
+
+    def save_weights(self, filepath):
+        """Params-only .npz in graph order (keras save_weights
+        analogue) — the full training state (optimizer slots + step) is
+        ``ffmodel.save_checkpoint``.  Keys are ``<index>:<name>`` so a
+        twin model whose auto-numbered layer names differ (keras names
+        are session-global) still loads by position.  Same write
+        invariants as save_checkpoint: all processes gather, process 0
+        publishes atomically (tmp + rename), everyone barriers."""
+        import jax
+        import numpy as np
+        assert self._compiled, "compile() first"
+        m = self.ffmodel
+        # graph DECLARATION order (m.parameters), not _params dict order:
+        # the jitted step returns params as a pytree, which sorts dict
+        # keys — so dict order differs before/after fit()
+        order = [p.name for p in m.parameters]
+        flat = {f"{i}:{k}": m._gather_host(m._params[k])
+                for i, k in enumerate(order)}
+        final = m._ckpt_path(str(filepath))
+        if jax.process_index() == 0:
+            tmp = final[:-len(".npz")] + ".tmp.npz"
+            np.savez(tmp, **flat)
+            os.replace(tmp, final)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("ff_weights_written")
+
+    def load_weights(self, filepath):
+        """Restore by name when the names match, else by graph position
+        (keras topological-order semantics); shape mismatches fail
+        loudly before any state mutates."""
+        import numpy as np
+        assert self._compiled, "compile() first"
+        m = self.ffmodel
+        path = m._ckpt_path(str(filepath))
+        with np.load(path) as f:
+            stored = sorted(((int(k.split(":", 1)[0]), k.split(":", 1)[1],
+                              k) for k in f.files))
+            # declaration order on this side too (see save_weights)
+            cur_names = [p.name for p in m.parameters]
+            if len(stored) != len(cur_names):
+                raise ValueError(
+                    f"weights file has {len(stored)} params, model has "
+                    f"{len(cur_names)}")
+            by_name = {name: key for _, name, key in stored}
+            pairs = ([(n, by_name[n]) for n in cur_names]
+                     if set(by_name) == set(cur_names)
+                     else list(zip(cur_names,
+                                   (key for _, _, key in stored))))
+            loaded = {}
+            for name, key in pairs:
+                cur = m._params[name]
+                val = np.asarray(f[key]).astype(cur.dtype)
+                if val.shape != tuple(cur.shape):
+                    raise ValueError(
+                        f"{name}: weights shape {val.shape} != "
+                        f"{tuple(cur.shape)}")
+                loaded[name] = val
+            for name, val in loaded.items():
+                m._params[name] = m._put_global(
+                    val, m._params[name].sharding)
 
     def evaluate(self, x, y, batch_size=None):
         return self.ffmodel.evaluate(x, y, batch_size=batch_size)
