@@ -20,6 +20,13 @@ from .config import FFConfig
 
 def main(argv=None) -> None:
     argv = list(sys.argv[1:] if argv is None else argv)
+    # built-in subcommands (no user script involved)
+    if argv and argv[0] == "search-bench":
+        # search-throughput microbenchmark: delta vs full re-simulation
+        # (JSON to stdout; see docs/strategy_search.md)
+        from .search.bench import main as bench_main
+        bench_main(argv[1:])
+        return
     script = None
     for a in argv:
         if a.endswith(".py"):

@@ -122,6 +122,7 @@ class FFConfig:
     # strategy search knobs (reference model.cc:1253-1260)
     search_budget: int = 0      # --budget: MCMC iterations
     search_alpha: float = 0.05  # --alpha: annealing temperature
+    search_chains: int = 1      # --chains: independent MCMC chains
     search_overlap_backward_update: bool = False
     import_strategy_file: str = ""
     export_strategy_file: str = ""
@@ -215,6 +216,8 @@ class FFConfig:
                 cfg.search_budget = int(val())
             elif a == "--alpha":
                 cfg.search_alpha = float(val())
+            elif a == "--chains":
+                cfg.search_chains = max(1, int(val()))
             elif a == "--overlap":
                 cfg.search_overlap_backward_update = True
             elif a in ("-s", "--export"):

@@ -24,18 +24,30 @@ _tried = False
 
 
 def _build() -> bool:
+    # build to a temp name, then atomically replace: linking straight
+    # onto _LIB would truncate an inode the process may already have
+    # mmapped (SIGBUS), and the fresh inode guarantees a later dlopen
+    # loads the NEW code instead of the cached mapping
+    tmp = _LIB + ".tmp"
     try:
         r = subprocess.run(
             ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC,
-             "-o", _LIB],
+             "-o", tmp],
             capture_output=True, text=True, timeout=120)
         if r.returncode != 0:
             warnings.warn(f"native simulator build failed: {r.stderr[:500]}")
             return False
+        os.replace(tmp, _LIB)
         return True
     except (OSError, subprocess.TimeoutExpired) as e:
         warnings.warn(f"native simulator build unavailable: {e}")
         return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
 
 
 def load_ffsim() -> Optional[ctypes.CDLL]:
@@ -58,7 +70,8 @@ def load_ffsim() -> Optional[ctypes.CDLL]:
     i32p = ctypes.POINTER(ctypes.c_int32)
     i64p = ctypes.POINTER(ctypes.c_int64)
     f64p = ctypes.POINTER(ctypes.c_double)
-    lib.ffsim_simulate.restype = ctypes.c_double
+    f64 = ctypes.c_double
+    lib.ffsim_simulate.restype = f64
     lib.ffsim_simulate.argtypes = [
         ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
         f64p, f64p, f64p,          # fwd, bwd, sync times
@@ -66,8 +79,46 @@ def load_ffsim() -> Optional[ctypes.CDLL]:
         i32p, i32p,                # dev_off, dev_ids
         i32p, i32p, i32p, i64p,    # in_off, in_producer, in_rank, in_shape
         ctypes.c_int32,
-        ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_double,
+        f64, f64, f64, f64,
     ]
     lib.ffsim_version.restype = ctypes.c_int32
+    if lib.ffsim_version() < 2:
+        # a pre-stateful .so whose mtime is NEWER than the source (an
+        # artifact copy / docker COPY) dodged the mtime rebuild above —
+        # rebuild explicitly and reload before giving up
+        if not _build():
+            warnings.warn("native simulator library is stale (version "
+                          f"{lib.ffsim_version()} < 2) and could not be "
+                          "rebuilt; using the pure-Python simulator")
+            return None
+        lib = ctypes.CDLL(_LIB)
+        lib.ffsim_version.restype = ctypes.c_int32
+        if lib.ffsim_version() < 2:
+            warnings.warn("native simulator library is still stale after "
+                          "a rebuild; using the pure-Python simulator")
+            return None
+    # stateful delta-simulation API (SimSession): marshal the static
+    # topology once, then update one op's row per proposal
+    lib.ffsim_create.restype = ctypes.c_void_p
+    lib.ffsim_create.argtypes = [
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        i32p, i64p,                # rank, out_shape
+        i32p, i32p, i32p, i64p,    # in_off, in_producer, in_rank, in_shape
+        f64, f64, f64, f64,        # ici_bw, dcn_bw, latency, dtype_bytes
+        f64,                       # delta-repair threshold
+    ]
+    lib.ffsim_update_op.restype = ctypes.c_int32
+    lib.ffsim_update_op.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32,
+        f64, f64, f64,             # fwd, bwd, sync
+        i64p,                      # dims (MAXD, 1-padded)
+        ctypes.c_int32, i32p,      # n_dev, dev_ids
+    ]
+    lib.ffsim_state_simulate.restype = f64
+    lib.ffsim_state_simulate.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.ffsim_destroy.restype = None
+    lib.ffsim_destroy.argtypes = [ctypes.c_void_p]
+    lib.ffsim_stat.restype = ctypes.c_int64
+    lib.ffsim_stat.argtypes = [ctypes.c_void_p, ctypes.c_int32]
     _lib = lib
     return _lib

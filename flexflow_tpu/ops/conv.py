@@ -334,16 +334,23 @@ class Conv2D(Op):
 
     def backward_overhead(self, part_degrees=None):
         # strided dgrad lowers to a conv over the interior-dilated
-        # gradient (~s*s MAC waste).  r5 calibration, conv7x7/s2 row:
-        # analytic fwd 0.411 + bwd 0.820 = 1.231 ms vs measured 3.155 ms
-        # with fwd alone matching (0.371) -> measured bwd 2.78 ms =
-        # 3.4x the 2x-forward model.  Stride-1 conv rows match the model
-        # (1.06-1.12x), no correction.  Deliberately does NOT consult
-        # _use_fast_dgrad(): the tuned table never ships fast_dgrad on
-        # TPU (microbench: the phase decomposition is 2.6x slower than
-        # the dilated lowering there), and on the CPU test backend these
-        # TPU-calibrated factors are nominal either way.
-        return 3.4 if max(self.stride) > 1 else 1.0
+        # gradient, whose MAC waste grows ~s*s (the dilated input is
+        # s*s larger with the same nonzero count).  r5 calibration,
+        # conv7x7/s2 row: analytic fwd 0.411 + bwd 0.820 = 1.231 ms vs
+        # measured 3.155 ms with fwd alone matching (0.371) -> measured
+        # bwd 2.78 ms = 3.4x the 2x-forward model.  Anchoring the s*s
+        # law at that point: overhead(s) = 1 + 2.4 * s*s / 4, so s=2
+        # reproduces the measured 3.4x and stride-3+ convs scale instead
+        # of reusing one constant (ADVICE r5: a flat 3.4x mis-costs
+        # stride-3/tiny-kernel convs in analytic search mode).  Stride-1
+        # conv rows match the 2x-forward model (1.06-1.12x), no
+        # correction.  Deliberately does NOT consult _use_fast_dgrad():
+        # the tuned table never ships fast_dgrad on TPU (microbench: the
+        # phase decomposition is 2.6x slower than the dilated lowering
+        # there), and on the CPU test backend these TPU-calibrated
+        # factors are nominal either way.
+        s = max(self.stride)
+        return 1.0 + 2.4 * (s * s) / 4.0 if s > 1 else 1.0
 
     def flops(self):
         n, c_out, oh, ow = self.outputs[0].shape

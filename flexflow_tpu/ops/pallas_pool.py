@@ -175,7 +175,7 @@ def _neg(dtype):
         else int(jnp.iinfo(dtype).min)
 
 
-def _tile_bytes(h, w, oh, ow, kernel, stride, cb, bb, itemsize):
+def _tile_bytes(h, w, oh, ow, kernel, stride, padding, cb, bb, itemsize):
     """Live-tile estimate for the backward kernel (the larger of the
     two directions), as the max over its two phases — the mask loop
     (xp/y/claimed/g + phase planes live) and the interleave (planes +
@@ -183,7 +183,13 @@ def _tile_bytes(h, w, oh, ow, kernel, stride, cb, bb, itemsize):
     as a go/no-go against _VMEM_BUDGET."""
     kh, kw = kernel
     sh, sw = stride
-    hq, wq = h + 2 * stride[0], w + 2 * stride[1]  # pad upper bound
+    ph, pw = padding
+    # _pad_input produces h + 2*ph + (sh - 1): `padding` rows each side
+    # plus the zero tail that rounds up to a whole window phase.  The
+    # previous h + 2*sh guess under-counted whenever padding exceeds
+    # stride (7x7 window, pad 3, stride 1), letting supported() approve
+    # a shape whose backward tile busts _VMEM_BUDGET (ADVICE r5)
+    hq, wq = h + 2 * ph + sh - 1, w + 2 * pw + sw - 1
     t_n, u_n = (kh - 1) // sh + oh, (kw - 1) // sw + ow
     mask_loop = (hq * wq                  # xp
                  + 4 * oh * ow            # y, g, claimed, contrib temp
@@ -207,7 +213,7 @@ def supported(x_shape, dtype, kernel, stride, padding) -> bool:
         return False
     cb = min(c, 128)
     itemsize = jnp.dtype(dtype).itemsize
-    return _tile_bytes(h, w, oh, ow, kernel, stride, cb, 1,
+    return _tile_bytes(h, w, oh, ow, kernel, stride, padding, cb, 1,
                        itemsize) <= _VMEM_BUDGET
 
 

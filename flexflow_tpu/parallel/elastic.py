@@ -51,6 +51,9 @@ class AttemptResult:
     timed_out: bool
     elapsed_s: float
     tails: Dict[int, str]       # rank -> tail of combined stdout+stderr log
+    # transient OSError from Popen while spawning (ADVICE r5): recorded
+    # so the failure consumes a restart instead of aborting supervision
+    spawn_error: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -93,17 +96,27 @@ def run_elastic(worker_argv: Callable[[int, int, int], Sequence[str]],
         logdir = tempfile.mkdtemp(prefix=f"ff_elastic_a{attempt}_")
         logs = []
         t0 = time.monotonic()
+        failed_rank: Optional[int] = None
+        timed_out = False
+        spawn_error: Optional[str] = None
         try:
-            for rank in range(num_processes):
-                lf = open(os.path.join(logdir, f"rank{rank}.log"), "w+b")
-                logs.append(lf)
-                procs.append(subprocess.Popen(
-                    list(worker_argv(attempt, port, rank)),
-                    stdout=lf, stderr=subprocess.STDOUT,
-                    env=worker_env))
-            failed_rank: Optional[int] = None
-            timed_out = False
-            while True:
+            # a transient OSError (fd exhaustion, ENOMEM, a briefly
+            # missing interpreter on shared storage) from open/Popen is
+            # an attempt FAILURE, not a supervision abort: record it,
+            # reap whatever spawned, and let the restart loop retry
+            try:
+                for rank in range(num_processes):
+                    lf = open(os.path.join(logdir, f"rank{rank}.log"),
+                              "w+b")
+                    logs.append(lf)
+                    procs.append(subprocess.Popen(
+                        list(worker_argv(attempt, port, rank)),
+                        stdout=lf, stderr=subprocess.STDOUT,
+                        env=worker_env))
+            except OSError as e:
+                failed_rank = len(procs)  # the rank that failed to spawn
+                spawn_error = f"{type(e).__name__}: {e}"
+            while spawn_error is None:
                 codes = [p.poll() for p in procs]
                 bad = [r for r, c in enumerate(codes)
                        if c is not None and c != 0]
@@ -143,7 +156,8 @@ def run_elastic(worker_argv: Callable[[int, int, int], Sequence[str]],
             port=port,
             returncodes=[p.returncode for p in procs],
             failed_rank=failed_rank, timed_out=timed_out,
-            elapsed_s=round(time.monotonic() - t0, 3), tails=tails)
+            elapsed_s=round(time.monotonic() - t0, 3), tails=tails,
+            spawn_error=spawn_error)
         attempts.append(result)
         if not timed_out and failed_rank is None \
                 and all(c == 0 for c in result.returncodes):

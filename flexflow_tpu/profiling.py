@@ -12,7 +12,7 @@ is set.
 from __future__ import annotations
 
 import time
-from typing import Dict, List
+from typing import Dict, List, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -113,6 +113,23 @@ def profile_op(op: Op, compute_dtype: str = "bfloat16", warmup: int = 2,
     # which misreports a failed backward as a free one
     bwd_ms = float("nan") if tot_ms != tot_ms else max(0.0, tot_ms - fwd_ms)
     return {"fwd_ms": fwd_ms, "bwd_ms": bwd_ms}
+
+
+def time_calls(fn, min_time_s: float = 0.3, max_calls: int = 1_000_000
+               ) -> Tuple[float, int]:
+    """(calls/sec, n_calls) of repeatedly invoking ``fn()`` until at
+    least ``min_time_s`` of wall clock accumulates.  Host-side CPU
+    timing for search-throughput benchmarks (``search-bench``) — the
+    simulator runs on the host, so no device fence is involved."""
+    import time as _time
+    n = 0
+    t0 = _time.perf_counter()
+    while True:
+        fn()
+        n += 1
+        dt = _time.perf_counter() - t0
+        if dt >= min_time_s or n >= max_calls:
+            return n / dt, n
 
 
 def _fence(out):

@@ -1,1 +1,10 @@
 from .mcmc import optimize_strategies
+
+
+def __getattr__(name):
+    # lazy: SimSession pulls in the simulator stack, which most
+    # importers of optimize_strategies never touch
+    if name == "SimSession":
+        from .session import SimSession
+        return SimSession
+    raise AttributeError(name)

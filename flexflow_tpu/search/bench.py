@@ -1,0 +1,172 @@
+"""``search-bench`` — search-throughput microbenchmark (delta vs full).
+
+Search throughput is the lever that lets a fixed wall-clock budget
+explore more strategies ("Learning to Optimize Tensor Programs": autotuning
+is search-throughput-bounded), and unlike chip benchmarks it is fully
+measurable on CPU.  This bench drives the SAME seeded single-op proposal
+sequence through
+
+* the one-shot path — ``Simulator.simulate()``, which re-marshals every
+  op and rebuilds the whole task graph per proposal, and
+* the delta path — :class:`~flexflow_tpu.search.session.SimSession`,
+  which re-simulates only what the proposal changed,
+
+and reports proposals/sec for each, plus the best simulated time a short
+real MCMC search finds.  Both paths share one plan cache (warmed before
+timing), so the measured ratio isolates the simulation machinery.
+
+Run: ``python -m flexflow_tpu.cli search-bench [--devices 16]
+[--steps 192] [--budget 200] [--seed 0] [--graphs transformer,dlrm]
+[--out artifacts/search_bench.json]`` — JSON on stdout either way.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+from ..config import FFConfig
+
+
+def _transformer_layers():
+    """Search-scale transformer (the ISSUE's flagship graph)."""
+    from ..models.transformer import build_transformer
+    cfg = FFConfig(batch_size=64, compute_dtype="float32")
+    model, _, _ = build_transformer(cfg, num_layers=2, d_model=128,
+                                    num_heads=4, d_ff=256, seq_len=32,
+                                    vocab_size=1000)
+    return model.layers
+
+
+def _dlrm_layers():
+    from ..models.dlrm import build_dlrm
+    cfg = FFConfig(batch_size=64, compute_dtype="float32")
+    model, _, _ = build_dlrm(cfg, embedding_size=(1000, 1000, 1000, 1000),
+                             sparse_feature_size=16,
+                             mlp_bot=(32, 64, 16), mlp_top=(80, 64, 1))
+    return model.layers
+
+
+GRAPHS = {"transformer": _transformer_layers, "dlrm": _dlrm_layers}
+
+
+def _proposal_sequence(layers, num_devices: int, steps: int, seed: int
+                       ) -> List[Dict]:
+    """A seeded random walk of single-op mutations (the MCMC proposal
+    shape) under one hybrid mesh factorization — each consecutive pair
+    of strategies differs in exactly one op."""
+    import random
+
+    from .mcmc import MeshShape, legal_configs  # noqa: F401
+    from ..parallel.mesh import AXES
+    rng = random.Random(seed)
+    # a hybrid n*c mesh so proposals include tensor-parallel splits
+    half = 1
+    while half * half <= num_devices:
+        half *= 2
+    half //= 2
+    mesh = {a: 1 for a in AXES}
+    mesh["n"] = max(1, num_devices // half)
+    mesh["c"] = half
+    cands = {op.name: legal_configs(op, mesh, seed=seed) for op in layers}
+    current = {op.name: cands[op.name][0] for op in layers}
+    seq = [dict(current)]
+    for _ in range(steps - 1):
+        op = rng.choice(layers)
+        current[op.name] = rng.choice(cands[op.name])
+        seq.append(dict(current))
+    return seq
+
+
+def bench_graph(name: str, num_devices: int = 16, steps: int = 192,
+                budget: int = 200, seed: int = 0,
+                min_time_s: float = 0.4) -> Dict:
+    """Delta-vs-full proposals/sec + best simulated time for one graph."""
+    from ..profiling import time_calls
+    from .mcmc import search
+    from .simulator import Simulator
+
+    layers = GRAPHS[name]()
+    sim = Simulator(num_devices=num_devices)
+    seq = _proposal_sequence(layers, num_devices, steps, seed)
+
+    # warm the shared plan cache (and the one-shot path) so both timed
+    # loops measure simulation, not first-touch plan construction
+    for strat in seq:
+        sim.simulate(layers, strat)
+
+    def run_full():
+        for strat in seq:
+            sim.simulate(layers, strat)
+
+    session = sim.session(layers)
+
+    def run_delta():
+        for strat in seq:
+            session.evaluate(strat)
+
+    run_delta()  # one warm pass: marshal + first full build
+    full_cps, _ = time_calls(run_full, min_time_s=min_time_s)
+    delta_cps, _ = time_calls(run_delta, min_time_s=min_time_s)
+    stats = session.stats()
+    session.close()
+
+    best, best_mesh, best_t = search(layers, num_devices, budget=budget,
+                                     seed=seed)
+    return {
+        "graph": name,
+        "num_ops": len(layers),
+        "num_devices": num_devices,
+        "proposal_steps": steps,
+        "proposals_per_sec_full": round(full_cps * steps, 2),
+        "proposals_per_sec_delta": round(delta_cps * steps, 2),
+        "speedup": round(delta_cps / full_cps, 2),
+        "backend": "native" if sim._native is not None else "python",
+        "engine_stats": stats,
+        "search_budget": budget,
+        "best_simulated_ms": (None if best_t != best_t or best_t == float("inf")
+                              else round(best_t * 1e3, 6)),
+        "best_mesh": {a: s for a, s in best_mesh.items() if s > 1},
+    }
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        prog="flexflow-tpu search-bench",
+        description="search-throughput microbenchmark: delta (SimSession) "
+                    "vs full (one-shot simulate) proposals/sec")
+    ap.add_argument("--devices", type=int, default=16)
+    ap.add_argument("--steps", type=int, default=192,
+                    help="proposals per timed pass")
+    ap.add_argument("--budget", type=int, default=200,
+                    help="MCMC iterations for the best-time search")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--graphs", default="transformer,dlrm",
+                    help="comma-separated subset of: "
+                         + ",".join(GRAPHS))
+    ap.add_argument("--min-time", type=float, default=0.4,
+                    help="seconds of wall clock per timed loop")
+    ap.add_argument("--out", default="",
+                    help="also write the JSON artifact here")
+    args = ap.parse_args(argv)
+    names = [g.strip() for g in args.graphs.split(",") if g.strip()]
+    for g in names:
+        if g not in GRAPHS:
+            ap.error(f"unknown graph {g!r}; choose from {sorted(GRAPHS)}")
+    results = [bench_graph(g, num_devices=args.devices, steps=args.steps,
+                           budget=args.budget, seed=args.seed,
+                           min_time_s=args.min_time)
+               for g in names]
+    payload = {"bench": "search-bench", "results": results}
+    text = json.dumps(payload, indent=2)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+        print(f"# wrote {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

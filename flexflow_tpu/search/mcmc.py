@@ -191,7 +191,7 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
            devices_per_slice=_UNSET, remat=_UNSET,
            compute_dtype=_UNSET, conv_layout=_UNSET,
            opt_slot_bytes=_UNSET, sparse_tables=_UNSET,
-           sim: Optional[Simulator] = None
+           sim: Optional[Simulator] = None, chains: int = 1
            ) -> Tuple[Dict[str, ParallelConfig], MeshShape, float]:
     """Run the annealing loop; returns (best strategies, best mesh
     factorization, best simulated time).  ``devices_per_slice`` < the
@@ -199,8 +199,15 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
     groups that cross a slice pay the DCN term (reference
     simulator.cu:27-29 inter-node fabric).  ``sim`` lets the caller
     share a Simulator (and, in measure mode, its on-chip measurement
-    cache) with its own baseline evaluations."""
-    rng = random.Random(seed)
+    cache) with its own baseline evaluations.
+
+    ``chains`` > 1 runs that many INDEPENDENT anneals (each with its own
+    rng stream and delta-simulation :class:`SimSession`, all sharing the
+    plan/measure caches and the multi-start seeds) and reduces to the
+    best strategy by (time, chain index) — deterministic under a fixed
+    seed, and chain 0 reproduces the single-chain walk exactly.  Analytic
+    chains run in threads (the native engine releases the GIL); measure
+    mode runs them sequentially to keep one on-chip profiling pipeline."""
     # one (name, value) table serves both branches: the contradiction
     # check against a shared sim AND the pass-through construction —
     # a new Simulator-mirrored kwarg is added in exactly one place
@@ -322,46 +329,82 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
         cur_time = sim.simulate(layers, current, overlap_backward_update,
                                 mesh_shape=mesh_shape)
     best, best_mesh, best_time = dict(current), dict(mesh_shape), cur_time
-    for it in range(budget):
-        if len(meshes) > 1 and rng.random() < 0.1:
-            # re-factorize the mesh: re-seed from the (memoized) greedy or
-            # aligned init (snapping existing degrees produces a crippled
-            # low-degree strategy that is always rejected — the round-3
-            # dead end)
-            new_mesh = rng.choice(meshes)
-            if tuple(new_mesh.values()) == tuple(mesh_shape.values()):
-                continue
-            proposal = rng.choice(mesh_seeds(new_mesh))[0]
-            prop_mesh = new_mesh
-        else:
-            op = rng.choice(layers)
-            choices = cands(op, mesh_shape)
-            if not choices:
-                continue
-            new_cfg = rng.choice(choices)
-            if new_cfg.dims == current[op.name].dims:
-                continue
-            proposal = dict(current)
-            proposal[op.name] = new_cfg
-            prop_mesh = mesh_shape
-        new_time = sim.simulate(layers, proposal, overlap_backward_update,
-                                mesh_shape=prop_mesh)
-        delta = new_time - cur_time
-        # inf -> inf moves are accepted unconditionally: when the start
-        # point is infeasible (e.g. DP blows the HBM budget) the walk must
-        # be able to drift across infeasible states (mesh refactorizations)
-        # until a feasible one appears; the reference never needs this
-        # because its DP start always fits (it measures on the real GPU)
-        both_inf = (not math.isfinite(new_time)
-                    and not math.isfinite(cur_time))
-        if both_inf or delta < 0 or (math.isfinite(new_time) and
-                                     rng.random() < math.exp(-alpha * delta * 1e3)):
-            current, cur_time, mesh_shape = proposal, new_time, prop_mesh
-            if cur_time < best_time:
-                best, best_mesh, best_time = (dict(current), dict(mesh_shape),
-                                              cur_time)
-                if verbose:
-                    print(f"[search] iter {it}: {best_time * 1e3:.3f} ms")
+
+    def run_chain(chain_idx: int):
+        """One independent anneal from the shared multi-start seed.
+        Chain 0 draws from ``Random(seed)`` so the single-chain walk (and
+        its acceptance decisions) is reproduced exactly; every chain
+        evaluates proposals through its own delta-simulation SimSession,
+        which is bit-identical to ``sim.simulate``."""
+        rng = random.Random(seed if chain_idx == 0
+                            else seed + 7919 * chain_idx)
+        cur, cur_t = dict(current), cur_time
+        ms_cur = dict(mesh_shape)
+        b, bm, bt = dict(cur), dict(ms_cur), cur_t
+        session = sim.session(layers, overlap_backward_update,
+                              mesh_shape=ms_cur)
+        try:
+            session.evaluate(cur, mesh_shape=ms_cur)  # marshal once
+            for it in range(budget):
+                if len(meshes) > 1 and rng.random() < 0.1:
+                    # re-factorize the mesh: re-seed from the (memoized)
+                    # greedy or aligned init (snapping existing degrees
+                    # produces a crippled low-degree strategy that is
+                    # always rejected — the round-3 dead end)
+                    new_mesh = rng.choice(meshes)
+                    if tuple(new_mesh.values()) == tuple(ms_cur.values()):
+                        continue
+                    proposal = rng.choice(mesh_seeds(new_mesh))[0]
+                    prop_mesh = new_mesh
+                else:
+                    op = rng.choice(layers)
+                    choices = cands(op, ms_cur)
+                    if not choices:
+                        continue
+                    new_cfg = rng.choice(choices)
+                    if new_cfg.dims == cur[op.name].dims:
+                        continue
+                    proposal = dict(cur)
+                    proposal[op.name] = new_cfg
+                    prop_mesh = ms_cur
+                new_time = session.evaluate(proposal, mesh_shape=prop_mesh)
+                delta = new_time - cur_t
+                # inf -> inf moves are accepted unconditionally: when the
+                # start point is infeasible (e.g. DP blows the HBM budget)
+                # the walk must be able to drift across infeasible states
+                # (mesh refactorizations) until a feasible one appears;
+                # the reference never needs this because its DP start
+                # always fits (it measures on the real GPU)
+                both_inf = (not math.isfinite(new_time)
+                            and not math.isfinite(cur_t))
+                if both_inf or delta < 0 or \
+                        (math.isfinite(new_time) and
+                         rng.random() < math.exp(-alpha * delta * 1e3)):
+                    cur, cur_t, ms_cur = proposal, new_time, prop_mesh
+                    if cur_t < bt:
+                        b, bm, bt = dict(cur), dict(ms_cur), cur_t
+                        if verbose:
+                            print(f"[search] chain {chain_idx} iter {it}: "
+                                  f"{bt * 1e3:.3f} ms")
+        finally:
+            session.close()
+        return bt, chain_idx, b, bm
+
+    chains = max(1, chains)
+    if chains == 1 or measure:
+        # measure mode keeps ONE on-chip profiling pipeline; the shared
+        # measure cache still de-duplicates across sequential chains
+        results = [run_chain(c) for c in range(chains)]
+    else:
+        import concurrent.futures as _cf
+        import os as _os
+        with _cf.ThreadPoolExecutor(
+                max_workers=min(chains, _os.cpu_count() or 1)) as ex:
+            results = list(ex.map(run_chain, range(chains)))
+    # deterministic reduce: best simulated time, ties to the lowest chain
+    bt, _, b, bm = min(results, key=lambda r: (r[0], r[1]))
+    if bt < best_time:
+        best, best_mesh, best_time = b, bm, bt
     return best, best_mesh, best_time
 
 
@@ -387,7 +430,12 @@ def optimize_strategies(model, cfg: FFConfig) -> Dict[str, ParallelConfig]:
     from ..op import resolve_conv_layout
     layout = resolve_conv_layout(cfg.conv_layout, model.layers)
     # tables on the sparse-update path sync row grads, not the table —
-    # the objective must cost what the run will actually move
+    # the objective must cost what the run will actually move.  This
+    # runs BEFORE _resolve_host_placements, so the model-level set is
+    # the "if device-placed" eligibility; the Simulator re-derives per
+    # candidate, treating host-placed configs as dense in sync/memory
+    # costing (ADVICE r5: hetero candidates would otherwise be scored
+    # with the cheap sparse row-grad sync they can't actually use)
     sparse_tables = {t for _, t, _ in model._sparse_embedding_specs()}
     best, best_mesh, best_time = search(
         model.layers, ndev, budget=cfg.search_budget,
@@ -397,7 +445,8 @@ def optimize_strategies(model, cfg: FFConfig) -> Dict[str, ParallelConfig]:
         flash_attention=cfg.flash_attention,
         devices_per_slice=dps, remat=cfg.remat,
         compute_dtype=cfg.compute_dtype, conv_layout=layout,
-        opt_slot_bytes=slot_bytes, sparse_tables=sparse_tables)
+        opt_slot_bytes=slot_bytes, sparse_tables=sparse_tables,
+        chains=cfg.search_chains)
     print(f"[search] best simulated iteration time: {best_time * 1e3:.3f} ms "
           f"on {ndev} devices, mesh "
           f"{ {a: s for a, s in best_mesh.items() if s > 1} }")

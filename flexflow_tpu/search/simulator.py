@@ -168,9 +168,19 @@ class Simulator:
         multi-start scans heavily-overlapping candidate sets across all
         mesh factorizations, and a plan depends only on the op and its
         own config."""
+        from ..ops.linear import host_placed
         pc = strategies.get(op.name)
+        # a host-placed candidate takes the dense host-gather path at run
+        # time, so its table must NOT get the sparse row-grad discount —
+        # sparsity eligibility is re-derived per candidate (ADVICE r5:
+        # optimize_strategies derives sparse_tables before host placements
+        # resolve, so the model-level set alone would mis-cost hetero
+        # candidates); the host bit is part of the plan key because it
+        # changes the sync cost
+        host = host_placed(pc)
+        sparse_tables = frozenset() if host else self.sparse_tables
         key = (op.name, None if pc is None
-               else (tuple(pc.dims), tuple(pc.device_ids)))
+               else (tuple(pc.dims), tuple(pc.device_ids), host))
         hit = self._plan_cache.get(key)
         if hit is not None:
             return hit
@@ -207,7 +217,7 @@ class Simulator:
                 if not w.trainable:
                     continue
                 wb = w.volume * 4
-                if w.name in self.sparse_tables:
+                if w.name in sparse_tables:
                     # sparse-update table: replicas exchange the touched
                     # row grads (ids x row width), never the full table
                     wb = op.inputs[0].volume * w.shape[-1] * 4
@@ -237,6 +247,7 @@ class Simulator:
         under remat without HBM pressure, BASELINE.md round-5).
         The reference grounds legality in real FB memory
         (simulator.cu:82-88); this is the explicit TPU analogue."""
+        from ..ops.linear import host_placed
         from ..parallel.mesh import dim_axis_names
         remat = self.remat if assume_remat is None else assume_remat
         stack = {a: (mesh_shape or {}).get(a, 1) for a in ("e", "p")}
@@ -257,13 +268,46 @@ class Simulator:
                     min(self.num_devices, out.shape[0]), out.num_dims).dims)
             else:
                 dims = pad_degrees(pc.dims, out.num_dims)
+            # host-placed candidates run the dense path — no sparse
+            # row-grad discount on their tables (mirrors _op_plan)
             total += op_memory_bytes(op, dims, self.dtype_bytes,
                                      opt_slot_bytes=self.opt_slot_bytes,
                                      axes=dim_axis_names(out.num_dims),
                                      stack_degrees=stack, remat=remat,
                                      act_scale=act_scale,
-                                     sparse_tables=self.sparse_tables)
+                                     sparse_tables=(frozenset()
+                                                    if host_placed(pc)
+                                                    else self.sparse_tables))
         return total
+
+    def _warn_remat_legality(self) -> None:
+        """One-shot warning when a remat=True simulator scores a strategy
+        inf on the NO-REMAT legality set (shared with SimSession so the
+        incremental path warns identically)."""
+        if self.remat and not self._warned_remat_legality:
+            self._warned_remat_legality = True
+            import warnings
+            warnings.warn(
+                "HBM legality charges the NO-REMAT activation set "
+                "even though this Simulator has remat=True: on-chip "
+                "memory_analysis showed XLA's footprint does not "
+                "shrink under segmented remat (BASELINE.md round-5); "
+                "strategies scoring inf here may still compile with "
+                "remat, but that is unverified", stacklevel=3)
+
+    def session(self, layers: List[Op], overlap_backward_update: bool = False,
+                mesh_shape: Optional[Dict[str, int]] = None,
+                backend: str = "auto", delta_threshold: float = 0.25):
+        """A :class:`~flexflow_tpu.search.session.SimSession` over this
+        simulator — the stateful delta-simulation fast path: the model is
+        marshaled once, each ``evaluate()`` re-simulates only what a
+        proposal changed, and peak memory is maintained incrementally.
+        Results are bit-identical to ``simulate()``."""
+        from .session import SimSession
+        return SimSession(self, layers,
+                          overlap_backward_update=overlap_backward_update,
+                          mesh_shape=mesh_shape, backend=backend,
+                          delta_threshold=delta_threshold)
 
     def _simulate_native(self, layers: List[Op],
                          strategies: Dict[str, ParallelConfig],
@@ -351,16 +395,7 @@ class Simulator:
         if (self.peak_memory_bytes(layers, strategies, mesh_shape,
                                    assume_remat=False)
                 * XLA_TEMP_FACTOR > self.spec.hbm_capacity):
-            if self.remat and not self._warned_remat_legality:
-                self._warned_remat_legality = True
-                import warnings
-                warnings.warn(
-                    "HBM legality charges the NO-REMAT activation set "
-                    "even though this Simulator has remat=True: on-chip "
-                    "memory_analysis showed XLA's footprint does not "
-                    "shrink under segmented remat (BASELINE.md round-5); "
-                    "strategies scoring inf here may still compile with "
-                    "remat, but that is unverified", stacklevel=2)
+            self._warn_remat_legality()
             return float("inf")
         if self._native is not None:
             t = self._simulate_native(layers, strategies,

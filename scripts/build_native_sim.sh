@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Rebuild the native event-driven simulator (flexflow_tpu/native/
+# libffsim-<platform>.so) from simulator.cpp.
+#
+# The Python loader (flexflow_tpu/native/__init__.py) rebuilds the
+# library automatically whenever the .cpp is newer than the .so, so this
+# script exists for (a) environments where the first import happens
+# without a writable checkout, (b) CI images that want the build to fail
+# loudly, and (c) committing a fresh .so after engine changes.  No
+# third-party deps — plain g++.
+#
+# Usage: scripts/build_native_sim.sh   (from anywhere inside the repo)
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+SRC=flexflow_tpu/native/simulator.cpp
+PLATFORM=$(python -c 'import sys; print(sys.platform)' 2>/dev/null || echo linux)
+OUT=flexflow_tpu/native/libffsim-${PLATFORM}.so
+
+g++ -O2 -shared -fPIC -std=c++17 "$SRC" -o "$OUT"
+echo "built $OUT"
+
+# sanity: the loader must accept it (version >= 2 = stateful delta API)
+python - <<'EOF'
+from flexflow_tpu.native import load_ffsim
+lib = load_ffsim()
+assert lib is not None, "loader rejected the freshly built library"
+print("ffsim_version:", lib.ffsim_version())
+EOF

@@ -79,3 +79,25 @@ def test_exhausted_restarts_reports_failure(tmp_path):
     assert len(report.attempts) == 2
     assert all(a.failed_rank == 0 or 3 in [c for c in a.returncodes if c]
                for a in report.attempts)
+
+
+def test_spawn_failure_consumes_restart():
+    """ADVICE r5: a transient OSError from Popen while spawning must be
+    recorded as a failed AttemptResult (consuming one restart) instead
+    of aborting supervision entirely."""
+    calls = []
+
+    def argv(attempt, port, rank):
+        calls.append(attempt)
+        return ["/nonexistent-binary-for-elastic-spawn-test"]
+
+    report = run_elastic(argv, num_processes=2, max_restarts=2,
+                         attempt_timeout_s=5.0, poll_interval_s=0.05)
+    assert not report.success
+    assert len(report.attempts) == 3  # every restart was consumed
+    for a in report.attempts:
+        assert a.spawn_error is not None
+        assert "nonexistent-binary" in a.spawn_error \
+            or "Errno" in a.spawn_error
+        assert a.failed_rank == 0  # rank 0 never spawned
+    assert report.restarts == 2

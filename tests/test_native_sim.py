@@ -30,7 +30,7 @@ def _inception_ish():
 def test_native_lib_builds():
     lib = load_ffsim()
     assert lib is not None, "g++ build of the native simulator failed"
-    assert lib.ffsim_version() == 1
+    assert lib.ffsim_version() >= 2  # 2 = stateful delta-simulation API
 
 
 @pytest.mark.parametrize("overlap", [False, True])

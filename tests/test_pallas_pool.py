@@ -196,3 +196,27 @@ def test_pool2d_op_uses_pallas(monkeypatch):
     monkeypatch.setenv("FF_PALLAS_POOL", "0")
     (y2,) = op.forward({}, [x], ctx_nchw)
     np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+
+
+def test_tile_bytes_counts_real_pad():
+    """ADVICE r5: _pad_input produces h + 2*ph + (sh-1) padded rows, not
+    h + 2*sh — when padding exceeds stride (7x7 window, pad 3) the old
+    guess under-counted VMEM and supported() approved shapes whose
+    backward tile busts _VMEM_BUDGET (a hard Mosaic compile error
+    instead of the intended graceful XLA fallback)."""
+    from flexflow_tpu.ops.pallas_pool import (_VMEM_BUDGET, _out_hw,
+                                              _tile_bytes, supported)
+    h = w = 96
+    kernel, stride, padding = (7, 7), (1, 1), (3, 3)
+    oh, ow = _out_hw(h, w, kernel, stride, padding)
+    est = _tile_bytes(h, w, oh, ow, kernel, stride, padding, 64, 1, 4)
+    # the old h + 2*stride formula for the same shape
+    t_n, u_n = (7 - 1) // 1 + oh, (7 - 1) // 1 + ow
+    old = max((h + 2) * (w + 2) + 4 * oh * ow + t_n * u_n,
+              2 * t_n * u_n + t_n * u_n + h * w) * 64 * 4
+    assert old <= _VMEM_BUDGET < est, (old, est, _VMEM_BUDGET)
+    # so the borderline shape is now (correctly) rejected ...
+    assert not supported((1, h, w, 64), jnp.float32, kernel, stride,
+                         padding)
+    # ... while ordinary pad <= stride shapes keep their go decision
+    assert supported((1, 32, 32, 64), jnp.float32, (3, 3), (2, 2), (1, 1))

@@ -1,0 +1,46 @@
+"""``search-bench`` CLI smoke (tier-1-safe): the harness that records
+the delta-vs-full speedup must keep emitting well-formed JSON with a
+positive throughput, so the bench trajectory can't silently rot."""
+
+import json
+import os
+import subprocess
+import sys
+
+from flexflow_tpu.search.bench import GRAPHS, bench_graph
+
+
+def test_bench_graph_json_shape():
+    """In-process: one tiny graph, tiny budget — well-formed result with
+    positive proposals/sec and the delta path at least as fast as full
+    (they share the plan cache, the delta path skips re-marshaling)."""
+    r = bench_graph("dlrm", num_devices=8, steps=24, budget=10,
+                    min_time_s=0.05)
+    json.dumps(r)  # must be JSON-serializable
+    assert r["proposals_per_sec_full"] > 0
+    assert r["proposals_per_sec_delta"] > 0
+    assert r["speedup"] > 1.0
+    assert r["num_ops"] == len(GRAPHS["dlrm"]())
+    assert r["best_simulated_ms"] is None or r["best_simulated_ms"] > 0
+
+
+def test_cli_search_bench_smoke(tmp_path):
+    """End-to-end through ``python -m flexflow_tpu.cli search-bench``:
+    stdout is valid JSON, the artifact file is written, and throughput
+    is positive."""
+    out = tmp_path / "bench.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "flexflow_tpu.cli", "search-bench",
+         "--devices", "8", "--steps", "16", "--budget", "5",
+         "--min-time", "0.05", "--graphs", "transformer",
+         "--out", str(out)],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    payload = json.loads(proc.stdout)
+    assert payload["bench"] == "search-bench"
+    (result,) = payload["results"]
+    assert result["graph"] == "transformer"
+    assert result["proposals_per_sec_delta"] > 0
+    assert result["proposals_per_sec_full"] > 0
+    assert json.loads(out.read_text()) == payload
