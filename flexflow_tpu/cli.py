@@ -12,6 +12,7 @@ available via :func:`flexflow_tpu.get_default_config` (scripts may also call
 
 from __future__ import annotations
 
+import os
 import runpy
 import sys
 
@@ -27,6 +28,10 @@ def main(argv=None) -> None:
         from .search.bench import main as bench_main
         bench_main(argv[1:])
         return
+    if argv and argv[0] == "elastic":
+        # supervised multi-process training with restart-from-checkpoint
+        # (docs/elastic.md)
+        raise SystemExit(elastic_main(argv[1:]))
     script = None
     for a in argv:
         if a.endswith(".py"):
@@ -34,6 +39,9 @@ def main(argv=None) -> None:
             break
     if script is None:
         print("usage: flexflow-tpu <script.py> [FlexFlow flags]\n"
+              "       flexflow-tpu elastic [supervisor flags] -- "
+              "<script.py> [script args]\n"
+              "       flexflow-tpu search-bench [flags]\n"
               "flags (reference model.cc:1221-1289): -e -b --lr --wd -d "
               "--budget --alpha -s/-import -ll:tpu -ll:cpu --nodes "
               "--profiling --seed --remat", file=sys.stderr)
@@ -53,6 +61,102 @@ def main(argv=None) -> None:
     # the script sees the remaining argv like any __main__
     sys.argv = [script] + flags
     runpy.run_path(script, run_name="__main__")
+
+
+def elastic_main(argv) -> int:
+    """``flexflow-tpu elastic [flags] -- <script.py> [script args]``:
+    run ``--nprocs`` copies of the script under the hardened elastic
+    supervisor (flexflow_tpu/parallel/elastic.py) — heartbeat hang
+    detection, failure classification, backoff-with-jitter restarts.
+
+    Each worker gets ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES``
+    / ``JAX_PROCESS_ID`` in its environment (fresh coordinator port per
+    attempt), which ``initialize_distributed()`` — called by any script
+    run through this CLI or flexflow_tpu directly — picks up.  Scripts
+    resume via ``resilience.elastic_resume(model, workdir)``; the
+    supervisor exports ``FF_ELASTIC_WORKDIR`` from ``--workdir``.
+    Returns the process exit code (0 on recovered success)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="flexflow-tpu elastic",
+        description="supervise an elastic multi-process training run")
+    parser.add_argument("--nprocs", type=int, default=1,
+                        help="worker processes per attempt")
+    parser.add_argument("--max-restarts", type=int, default=2)
+    parser.add_argument("--attempt-timeout", type=float, default=3600.0,
+                        metavar="S")
+    parser.add_argument("--hang-timeout", type=float, default=None,
+                        metavar="S",
+                        help="kill an attempt when no rank's heartbeat "
+                             "step advances for S seconds (off unless "
+                             "set; workers must beat via "
+                             "flexflow_tpu.resilience.Heartbeat)")
+    parser.add_argument("--workdir", default=".",
+                        help="checkpoint directory exported to workers "
+                             "as FF_ELASTIC_WORKDIR")
+    parser.add_argument("--backoff-base", type=float, default=0.5,
+                        metavar="S")
+    parser.add_argument("--backoff-max", type=float, default=30.0,
+                        metavar="S")
+    parser.add_argument("--backoff-seed", type=int, default=0)
+    if "--" not in argv:
+        parser.error("separate the worker script with '--': "
+                     "flexflow-tpu elastic --nprocs 2 -- train.py -b 64")
+    split = argv.index("--")
+    args = parser.parse_args(argv[:split])
+    worker_cmd = argv[split + 1:]
+    if not worker_cmd:
+        parser.error("no worker script given after '--'")
+
+    from .parallel.elastic import run_elastic
+
+    # a missing checkpoint dir would fail every attempt's first save
+    os.makedirs(args.workdir, exist_ok=True)
+
+    def worker_argv(attempt, port, rank):
+        # through the CLI harness, not bare python: FlexFlow flags after
+        # the script still parse into the default FFConfig, and main()'s
+        # initialize_distributed() picks up the JAX_* env below
+        return [sys.executable, "-m", "flexflow_tpu.cli", *worker_cmd]
+
+    def per_rank_env(attempt, port, rank):
+        return {"JAX_COORDINATOR_ADDRESS": f"localhost:{port}",
+                "JAX_NUM_PROCESSES": str(args.nprocs),
+                "JAX_PROCESS_ID": str(rank)}
+
+    report = run_elastic(
+        worker_argv, num_processes=args.nprocs,
+        max_restarts=args.max_restarts,
+        attempt_timeout_s=args.attempt_timeout,
+        hang_timeout_s=args.hang_timeout,
+        env={"FF_ELASTIC_WORKDIR": os.path.abspath(args.workdir)},
+        per_rank_env=per_rank_env,
+        backoff_base_s=args.backoff_base, backoff_max_s=args.backoff_max,
+        backoff_seed=args.backoff_seed)
+    for i, a in enumerate(report.attempts):
+        steps = (" steps=" + ",".join(
+            f"r{r}:{s}" for r, s in sorted(a.rank_steps.items()))
+            if a.rank_steps else "")
+        detail = f" ({a.spawn_error})" if a.spawn_error else ""
+        print(f"elastic attempt {i}: cause={a.cause} "
+              f"rc={a.returncodes} elapsed={a.elapsed_s}s"
+              f"{steps}{detail}", file=sys.stderr)
+        if a.cause != "ok" and a.failed_rank is not None:
+            tail = a.tails.get(a.failed_rank, "").strip()
+            if tail:
+                print(f"  rank {a.failed_rank} tail: ...{tail[-400:]}",
+                      file=sys.stderr)
+    if report.success:
+        print(f"elastic: success after {report.restarts} restart(s)",
+              file=sys.stderr)
+        return 0
+    print("elastic: FAILED"
+          + (" (fail-fast: instant all-rank crash on attempt 0 — "
+             "likely an argv/config error)" if report.fail_fast else
+             f" after {len(report.attempts)} attempt(s)"),
+          file=sys.stderr)
+    return 1
 
 
 if __name__ == "__main__":

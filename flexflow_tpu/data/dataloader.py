@@ -47,25 +47,42 @@ def synthetic_dataset(num_samples: int, input_shapes: Sequence[Tuple[int, ...]],
 def load_numpy_dataset(path: str):
     """Disk dataset loader (reference ImgDataLoader numpy path,
     flexflow_dataloader.cc:512-599): ``.npz`` archives with x*/y arrays, or
-    a bare ``.npy`` tensor.  Returns (inputs_list, labels_or_None)."""
-    if path.endswith(".npy"):
-        return [np.load(path)], None
-    with np.load(path, allow_pickle=False) as f:
-        keys = sorted(f.files)
-        # keras-layout archives carry BOTH splits; return the train split
-        # (x_test pairs with y_test, never with y_train)
-        if "x_train" in keys:
-            return [f["x_train"]], (f["y_train"] if "y_train" in keys
-                                    else None)
-        xs = [f[k] for k in keys
-              if k.startswith("x") and not k.startswith("x_test")]
-        ys = [f[k] for k in keys
-              if (k.startswith("y") and not k.startswith("y_test"))
-              or k == "label"]
-        if not xs:  # positional fallback: first n-1 arrays are inputs
-            arrays = [f[k] for k in keys]
-            xs, ys = arrays[:-1], arrays[-1:]
-        return xs, (ys[0] if ys else None)
+    a bare ``.npy`` tensor.  Returns (inputs_list, labels_or_None).
+
+    A truncated or bit-rotted archive raises
+    ``resilience.CorruptNpzError`` naming the path — not the bare
+    ``zipfile.BadZipFile`` numpy would surface."""
+    import zipfile
+    import zlib
+    try:
+        if path.endswith(".npy"):
+            return [np.load(path)], None
+        with np.load(path, allow_pickle=False) as f:
+            keys = sorted(f.files)
+            # keras-layout archives carry BOTH splits; return the train
+            # split (x_test pairs with y_test, never with y_train)
+            if "x_train" in keys:
+                return [f["x_train"]], (f["y_train"] if "y_train" in keys
+                                        else None)
+            xs = [f[k] for k in keys
+                  if k.startswith("x") and not k.startswith("x_test")]
+            ys = [f[k] for k in keys
+                  if (k.startswith("y") and not k.startswith("y_test"))
+                  or k == "label"]
+            if not xs:  # positional fallback: first n-1 arrays are inputs
+                arrays = [f[k] for k in keys]
+                xs, ys = arrays[:-1], arrays[-1:]
+            return xs, (ys[0] if ys else None)
+    except FileNotFoundError:
+        raise  # a missing dataset is not a corrupt one
+    except (zipfile.BadZipFile, zlib.error, ValueError, OSError,
+            EOFError) as e:
+        from ..resilience import CorruptNpzError
+        raise CorruptNpzError(
+            f"dataset archive {path!r} is corrupt or unreadable "
+            f"({type(e).__name__}: {e}); re-export the archive, or point "
+            f"the run at a valid one (synthetic data needs no file at "
+            f"all)") from e
 
 
 class SingleDataLoader:

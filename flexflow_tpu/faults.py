@@ -1,0 +1,261 @@
+"""Deterministic fault injection for the elastic training stack.
+
+The recovery paths in :mod:`flexflow_tpu.parallel.elastic` are only
+trustworthy if they are exercised by *real* multi-process failures, not
+mocks (ISSUE 2; the reference has no failure story at all — SURVEY §5).
+This module is the single switchboard: a fault plan is described in the
+``FF_FAULT`` environment variable, and the train loop
+(``FFModel.train_batch``/``fit``), the checkpoint writer
+(``FFModel.save_checkpoint``) and the supervisor (``run_elastic``) each
+consult it at well-defined points.  With ``FF_FAULT`` unset every hook
+is a cached ``None``-check — no behavior change, no measurable cost.
+
+Grammar (specs joined by ``;``, qualifiers by ``,``)::
+
+    FF_FAULT = spec (";" spec)*
+    spec     = kind ":" arg ("," key "=" value)*
+
+    kill_at_step:N        exit hard (os._exit, code 17) after step N completes
+    hang_at_step:N        stop making progress after step N (sleep forever —
+                          detected by the supervisor's heartbeat monitor)
+    corrupt_ckpt:N        truncate the checkpoint published at step N
+    corrupt_ckpt:latest   truncate every checkpoint this process publishes
+    spawn_fail_attempt:A  supervisor-side: fail attempt A at spawn time
+    slow_rank:R           rank R sleeps ``delay`` (default 0.25 s) per step
+
+    qualifiers: rank=R (fire only on rank R), attempt=A or attempt=*
+                (default attempt=0 — faults must not re-fire on the
+                restarted attempt or recovery could never be observed),
+                delay=SECONDS (slow_rank), exit=CODE (kill_at_step)
+
+Examples::
+
+    FF_FAULT="kill_at_step:7,rank=1"
+    FF_FAULT="corrupt_ckpt:4;kill_at_step:5,rank=1"
+    FF_FAULT="hang_at_step:5,rank=0,attempt=0"
+
+Rank resolution: workers call :func:`set_rank` (the
+``resilience.Heartbeat`` helper does it for them); otherwise
+``jax.process_index()`` is used when jax is already imported, else rank
+0.  A rank-qualified spec never fires when the rank is unknown.  The
+attempt comes from ``FF_ELASTIC_ATTEMPT`` (exported by the supervisor).
+
+Deliberately dependency-free (stdlib only) and importable standalone via
+``importlib`` file loading, so test workers can inject faults without
+paying the ``flexflow_tpu`` package import.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+# exit code for an injected kill — distinguishable from real crashes in
+# AttemptResult.returncodes (tests/test_elastic.py pins it)
+KILL_EXIT_CODE = 17
+
+KINDS = ("kill_at_step", "hang_at_step", "corrupt_ckpt",
+         "spawn_fail_attempt", "slow_rank")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    kind: str
+    arg: str
+    rank: Optional[int]      # None: any rank
+    attempt: Optional[int]   # None: any attempt
+    extras: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+def parse_faults(text: Optional[str]) -> List[FaultSpec]:
+    """Parse an ``FF_FAULT`` value.  Malformed specs and unknown kinds
+    raise ValueError loudly — a typo that silently injects nothing would
+    make a fault test vacuously green."""
+    specs: List[FaultSpec] = []
+    for raw in (text or "").split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        head, _, qual = raw.partition(",")
+        kind, sep, arg = head.partition(":")
+        kind, arg = kind.strip(), arg.strip()
+        if kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind {kind!r} in FF_FAULT spec {raw!r} "
+                f"(known: {', '.join(KINDS)})")
+        if not sep or not arg:
+            raise ValueError(f"fault spec {raw!r} is missing ':<arg>'")
+        rank: Optional[int] = None
+        # default attempt 0: a fault that re-fired on the restarted
+        # attempt would defeat every recovery test
+        attempt: Optional[int] = 0
+        extras: Dict[str, str] = {}
+        for kv in qual.split(","):
+            kv = kv.strip()
+            if not kv:
+                continue
+            key, sep2, val = kv.partition("=")
+            if not sep2:
+                raise ValueError(
+                    f"fault qualifier {kv!r} in {raw!r} is not key=value")
+            key, val = key.strip(), val.strip()
+            if key == "rank":
+                rank = int(val)
+            elif key == "attempt":
+                attempt = None if val == "*" else int(val)
+            elif key in ("delay", "exit"):
+                # validate now, fail at parse not at fire — with the
+                # type actually used at fire time (exit=9.5 must not
+                # blow up inside the train loop)
+                (float if key == "delay" else int)(val)
+                extras[key] = val
+            else:
+                raise ValueError(
+                    f"unknown fault qualifier {key!r} in {raw!r}")
+        # validate the arg NOW (same policy as delay/exit above): a typo
+        # like corrupt_ckpt:latst must fail at parse, not silently
+        # inject nothing — or blow up mid-training at fire time
+        if kind == "corrupt_ckpt":
+            if arg != "latest" and not arg.isdigit():
+                raise ValueError(
+                    f"corrupt_ckpt arg must be a step number or "
+                    f"'latest', got {arg!r} in {raw!r}")
+        elif not (arg.isdigit() or (arg[:1] == "-" and arg[1:].isdigit())):
+            raise ValueError(
+                f"{kind} arg must be an integer, got {arg!r} in {raw!r}")
+        if kind == "spawn_fail_attempt":
+            attempt = int(arg)  # the arg IS the attempt
+        specs.append(FaultSpec(kind, arg, rank, attempt, extras))
+    return specs
+
+
+# ----------------------------------------------------------------------
+# process-local plan (parsed once; reset() for in-process tests)
+# ----------------------------------------------------------------------
+_UNSET = object()
+_plan = _UNSET
+_rank: Optional[int] = None
+
+
+def plan() -> Optional[List[FaultSpec]]:
+    """The cached fault plan from ``FF_FAULT``, or None when unset."""
+    global _plan
+    if _plan is _UNSET:
+        text = os.environ.get("FF_FAULT")
+        _plan = parse_faults(text) if text else None
+    return _plan  # type: ignore[return-value]
+
+
+def reset() -> None:
+    """Drop the cached plan and rank (tests mutate the environment)."""
+    global _plan, _rank
+    _plan = _UNSET
+    _rank = None
+
+
+def set_rank(rank: int) -> None:
+    """Register this process's rank (workers call it at startup; the
+    ``resilience.Heartbeat`` helper does it implicitly)."""
+    global _rank
+    _rank = int(rank)
+
+
+def current_rank() -> Optional[int]:
+    if _rank is not None:
+        return _rank
+    if "jax" in sys.modules:  # never trigger the heavyweight import
+        try:
+            return int(sys.modules["jax"].process_index())
+        except Exception:
+            return None
+    return None
+
+
+def current_attempt() -> int:
+    return int(os.environ.get("FF_ELASTIC_ATTEMPT", "0"))
+
+
+def _matches(spec: FaultSpec) -> bool:
+    if spec.attempt is not None and spec.attempt != current_attempt():
+        return False
+    if spec.rank is not None:
+        r = current_rank()
+        if r is None or r != spec.rank:
+            return False
+    return True
+
+
+def _note(msg: str) -> None:
+    # stderr lands in the supervisor's per-rank log tail — forensics for
+    # a failed matrix test come for free
+    print(f"FF_FAULT: {msg}", file=sys.stderr, flush=True)
+
+
+# ----------------------------------------------------------------------
+# fire points
+# ----------------------------------------------------------------------
+def on_step(step: int) -> None:
+    """Train-loop hook: call after step ``step`` completes.  May sleep
+    (slow_rank), stop progressing (hang_at_step) or kill the process
+    (kill_at_step).  No-op without an active plan."""
+    p = plan()
+    if not p:
+        return
+    for spec in p:
+        if not _matches(spec):
+            continue
+        if spec.kind == "slow_rank":
+            r = current_rank()
+            if r is not None and r == int(spec.arg):
+                time.sleep(float(spec.extras.get("delay", "0.25")))
+        elif spec.kind == "hang_at_step" and step == int(spec.arg):
+            _note(f"injected hang at step {step} "
+                  f"(rank {current_rank()}, attempt {current_attempt()})")
+            while True:  # no progress, no exit: only heartbeat monitoring
+                time.sleep(3600)  # (or the attempt timeout) can end this
+        elif spec.kind == "kill_at_step" and step == int(spec.arg):
+            code = int(spec.extras.get("exit", str(KILL_EXIT_CODE)))
+            _note(f"injected kill at step {step} "
+                  f"(rank {current_rank()}, attempt {current_attempt()}, "
+                  f"exit {code})")
+            os._exit(code)  # hard crash: no cleanup, no excepthook
+
+
+def corrupt_file(path: str) -> None:
+    """The corruption primitive: truncate to half size, simulating a
+    writer killed mid-write / a disk-full partial flush.  The result is
+    not a valid zip, so both ``np.load`` and the checkpoint manifest
+    verification reject it."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        fh.truncate(max(1, size // 2))
+
+
+def maybe_corrupt_checkpoint(path: str, step: int) -> None:
+    """Checkpoint-writer hook: call after publishing ``path`` for
+    ``step``.  ``corrupt_ckpt:N`` corrupts the step-N file only;
+    ``corrupt_ckpt:latest`` corrupts every file this process writes."""
+    p = plan()
+    if not p:
+        return
+    for spec in p:
+        if spec.kind != "corrupt_ckpt" or not _matches(spec):
+            continue
+        if spec.arg == "latest" or (spec.arg.isdigit()
+                                    and int(spec.arg) == step):
+            corrupt_file(path)
+            _note(f"injected checkpoint corruption: {path} (step {step})")
+
+
+def spawn_fail_requested(env: Dict[str, str], attempt: int) -> bool:
+    """Supervisor-side hook: should ``attempt`` fail at spawn time?
+    Parses the worker environment (not this process's cached plan — the
+    supervisor's own FF_FAULT may differ from what it exports)."""
+    text = env.get("FF_FAULT")
+    if not text:
+        return False
+    return any(s.kind == "spawn_fail_attempt" and s.attempt == attempt
+               for s in parse_faults(text))

@@ -149,15 +149,19 @@ class ModelCheckpoint(Callback):
     (``save_best_only``) — via ``save_checkpoint``; ``async_write``
     (default) overlaps serialization with the next epoch.  ``filepath``
     may contain ``{epoch}`` and any reported scalar
-    (``{val_loss:.4f}``, ...)."""
+    (``{val_loss:.4f}``, ...).  For step-numbered filepaths
+    (``..._step{epoch}``-style families) ``keep_last=K`` retains only
+    the newest K checkpoints on disk — long elastic runs checkpoint
+    every epoch and would otherwise fill shared storage."""
 
     def __init__(self, filepath, monitor="val_loss", save_best_only=False,
-                 mode="auto", async_write=True, verbose=0):
+                 mode="auto", async_write=True, verbose=0, keep_last=None):
         super().__init__()
         self.filepath = str(filepath)
         self.monitor = monitor
         self.save_best_only = bool(save_best_only)
         self.async_write = bool(async_write)
+        self.keep_last = keep_last
         self.verbose = verbose
         if mode not in ("auto", "min", "max"):
             raise ValueError(f"mode must be auto|min|max, got {mode!r}")
@@ -185,7 +189,8 @@ class ModelCheckpoint(Callback):
                 return
             self.best = value
         path = self.filepath.format(epoch=epoch, **scalars)
-        self.model.save_checkpoint(path, async_write=self.async_write)
+        self.model.save_checkpoint(path, async_write=self.async_write,
+                                   keep_last=self.keep_last)
         if self.verbose:
             print(f"saved checkpoint {path}")
 

@@ -163,7 +163,6 @@ class BaseModel:
         invariants as save_checkpoint: all processes gather, process 0
         publishes atomically (tmp + rename), everyone barriers."""
         import jax
-        import numpy as np
         assert self._compiled, "compile() first"
         m = self.ffmodel
         # graph DECLARATION order (m.parameters), not _params dict order:
@@ -174,9 +173,8 @@ class BaseModel:
                 for i, k in enumerate(order)}
         final = m._ckpt_path(str(filepath))
         if jax.process_index() == 0:
-            tmp = final[:-len(".npz")] + ".tmp.npz"
-            np.savez(tmp, **flat)
-            os.replace(tmp, final)
+            from ..resilience import _atomic_savez
+            _atomic_savez(final, flat)  # same tmp+rename as save_checkpoint
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("ff_weights_written")

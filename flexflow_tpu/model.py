@@ -21,12 +21,64 @@ TPU-native re-design of the reference's god object (``include/model.h:240-429``,
 from __future__ import annotations
 
 import os
+import re
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from . import faults
+from .resilience import (MANIFEST_KEY, _atomic_savez, build_manifest,
+                         read_npz_verified)
+
+# "<anything>_step<N>.npz" — the family naming convention elastic
+# checkpoints use; retention and stale-tmp sweeps operate on it
+_STEP_FAMILY_RE = re.compile(r"^(?P<family>.+_step)\d+\.npz$")
+
+
+def _cleanup_stale_tmps(final: str) -> None:
+    """Remove orphaned ``*.tmp.npz`` siblings of ``final``: a worker
+    killed mid-``np.savez`` (or a disk-full async writer) leaves them
+    behind, and nothing else ever deletes them.  Scoped to the same
+    checkpoint family (``<name>_step<N>`` siblings, or the exact name
+    for step-less paths) so unrelated tmp files are untouched."""
+    d = os.path.dirname(final) or "."
+    base = os.path.basename(final)
+    m = _STEP_FAMILY_RE.match(base)
+    if m is not None:
+        pat = re.compile(re.escape(m.group("family")) + r"\d+\.tmp\.npz$")
+    else:
+        pat = re.compile(re.escape(base[:-len(".npz")]) + r"\.tmp\.npz$")
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return
+    for n in names:
+        if pat.fullmatch(n):
+            try:
+                os.remove(os.path.join(d, n))
+            except OSError:
+                pass
+
+
+def _prune_step_family(final: str, keep_last: int) -> None:
+    """Retention for step-numbered checkpoint families: after ``final``
+    is published, keep only the newest ``keep_last`` of its
+    ``<name>_step<N>.npz`` siblings.  No-op for step-less names —
+    there is no family to prune."""
+    m = _STEP_FAMILY_RE.match(os.path.basename(final))
+    if m is None:
+        return
+    from .parallel.elastic import _step_checkpoints
+    prefix = m.group("family")[:-len("_step")]
+    d = os.path.dirname(final) or "."
+    for _, p in _step_checkpoints(d, prefix)[max(1, int(keep_last)):]:
+        try:
+            os.remove(p)
+        except OSError:
+            pass
 
 from . import losses as losses_mod
 from . import metrics as metrics_mod
@@ -898,7 +950,8 @@ class FFModel:
         # here so save/load agree on the on-disk name
         return path if path.endswith(".npz") else path + ".npz"
 
-    def save_checkpoint(self, path: str, async_write: bool = False) -> None:
+    def save_checkpoint(self, path: str, async_write: bool = False,
+                        keep_last: Optional[int] = None) -> None:
         """Write params + optimizer state + step to one ``.npz``.  In
         multi-host runs every process participates in the gather, only
         process 0 writes the file, and all processes synchronize after the
@@ -911,7 +964,15 @@ class FFModel:
         rename — the slow disk half for multi-GB models — runs in a
         background thread.  Single-process only (the multi-host barrier
         must observe the completed write); a later save/load/exit joins
-        the pending writer first via :meth:`wait_for_checkpoint`."""
+        the pending writer first via :meth:`wait_for_checkpoint`.
+
+        The file embeds an integrity manifest (per-array CRC32 + step +
+        format version, under ``meta:manifest``) which
+        :meth:`load_checkpoint` and ``resilience.verify_checkpoint``
+        check before trusting the file.  ``keep_last=K`` prunes older
+        ``<name>_step<N>.npz`` siblings after a successful publish so
+        long elastic runs do not fill the disk; stale ``*.tmp.npz``
+        orphans from killed writers are swept on every save."""
         flat: Dict[str, np.ndarray] = {}
         for k, v in self._params.items():
             flat[f"param:{k}"] = self._gather_host(v)
@@ -921,18 +982,27 @@ class FFModel:
         flat["meta:step"] = np.asarray(self._step, np.int64)
         self.wait_for_checkpoint()  # one writer at a time, in order
         if jax.process_index() == 0:
-            # atomic publish: a crash/kill mid-save must never leave a
-            # truncated file at the final name — a corrupt "newest"
-            # checkpoint would wedge every elastic-restart attempt
-            # (parallel/elastic.py resumes from the newest by step).
-            # The tmp name keeps the .npz suffix so np.savez writes
-            # exactly there (it appends .npz to suffix-less paths).
+            # atomic publish (resilience._atomic_savez): a crash/kill
+            # mid-save must never leave a truncated file at the final
+            # name — a corrupt "newest" checkpoint would cost every
+            # elastic restart one verification-and-fallback pass
+            # (parallel/elastic.py resumes newest-valid by step).
             final = self._ckpt_path(path)
-            tmp = final[:-len(".npz")] + ".tmp.npz"
+            _cleanup_stale_tmps(final)
+            step = self._step
 
             def write():
-                np.savez(tmp, **flat)
-                os.replace(tmp, final)
+                # manifest here: writing rank only (the N-1 non-writers
+                # never need the CRC pass), and under async_write the
+                # full-state CRC runs in the background thread with the
+                # rest of the slow serialization half, not on the train
+                # loop (flat is fully materialized at this point)
+                flat[MANIFEST_KEY] = np.asarray(
+                    build_manifest(flat, step))
+                _atomic_savez(final, flat)
+                faults.maybe_corrupt_checkpoint(final, step)
+                if keep_last is not None:
+                    _prune_step_family(final, keep_last)
 
             if async_write and jax.process_count() == 1:
                 def guarded():
@@ -977,51 +1047,66 @@ class FFModel:
     def load_checkpoint(self, path: str) -> None:
         """Restore a checkpoint written by :meth:`save_checkpoint`,
         re-applying each parameter's sharding (incl. host placement).
-        Validates the full key set BEFORE mutating any state, so a graph or
+        Verifies integrity first — a truncated/bit-rotted file raises
+        ``resilience.CorruptCheckpointError`` naming the path (instead
+        of an opaque ``zipfile.BadZipFile``), and the embedded manifest's
+        per-array CRC32s are checked — then validates the full key set,
+        all BEFORE mutating any state, so a corrupt file or a graph /
         optimizer mismatch fails cleanly instead of half-restoring."""
         assert self._compiled, "call compile() + init_layers() first"
         self.wait_for_checkpoint()  # never read under a pending writer
-        with np.load(self._ckpt_path(path)) as f:
-            ckpt_params = {k[len("param:"):] for k in f.files
-                           if k.startswith("param:")}
-            cur_params = set(self._params)
-            if ckpt_params != cur_params:
-                missing = sorted(cur_params - ckpt_params)
-                extra = sorted(ckpt_params - cur_params)
+        path = self._ckpt_path(path)
+        data = read_npz_verified(path, what="checkpoint")
+        self._restore_from_host(data)
+
+    def _restore_from_host(self, data: Dict[str, np.ndarray]) -> None:
+        """Validate + apply already-read (and already CRC-verified)
+        checkpoint arrays — the shared tail of :meth:`load_checkpoint`
+        and ``resilience.elastic_resume`` (which probes candidate files
+        with ``read_npz_verified`` and must not pay a second full read +
+        CRC pass for the winner)."""
+        assert self._compiled, "call compile() + init_layers() first"
+        keys = set(data) - {MANIFEST_KEY}
+        ckpt_params = {k[len("param:"):] for k in keys
+                       if k.startswith("param:")}
+        cur_params = set(self._params)
+        if ckpt_params != cur_params:
+            missing = sorted(cur_params - ckpt_params)
+            extra = sorted(ckpt_params - cur_params)
+            raise ValueError(
+                f"checkpoint does not match this model: "
+                f"missing params {missing[:5]}, unexpected {extra[:5]}")
+        bad_shapes = [
+            (n, data[f"param:{n}"].shape, tuple(self._params[n].shape))
+            for n in sorted(ckpt_params)
+            if data[f"param:{n}"].shape != tuple(self._params[n].shape)]
+        if bad_shapes:
+            raise ValueError(
+                f"checkpoint does not match this model: shape "
+                f"mismatches {bad_shapes[:5]}")
+        leaves, treedef = jax.tree_util.tree_flatten(self._opt_state)
+        n_opt = sum(1 for k in keys if k.startswith("opt:"))
+        if n_opt != len(leaves):
+            raise ValueError(
+                f"optimizer state mismatch: checkpoint has {n_opt} "
+                f"slots, this optimizer has {len(leaves)} (was it saved "
+                f"with a different optimizer?)")
+        for i, leaf in enumerate(leaves):
+            if data[f"opt:{i}"].shape != tuple(leaf.shape):
                 raise ValueError(
-                    f"checkpoint does not match this model: "
-                    f"missing params {missing[:5]}, unexpected {extra[:5]}")
-            bad_shapes = [
-                (n, f[f"param:{n}"].shape, tuple(self._params[n].shape))
-                for n in sorted(ckpt_params)
-                if f[f"param:{n}"].shape != tuple(self._params[n].shape)]
-            if bad_shapes:
-                raise ValueError(
-                    f"checkpoint does not match this model: shape "
-                    f"mismatches {bad_shapes[:5]}")
-            leaves, treedef = jax.tree_util.tree_flatten(self._opt_state)
-            n_opt = sum(1 for k in f.files if k.startswith("opt:"))
-            if n_opt != len(leaves):
-                raise ValueError(
-                    f"optimizer state mismatch: checkpoint has {n_opt} "
-                    f"slots, this optimizer has {len(leaves)} (was it saved "
-                    f"with a different optimizer?)")
-            for i, leaf in enumerate(leaves):
-                if f[f"opt:{i}"].shape != tuple(leaf.shape):
-                    raise ValueError(
-                        f"optimizer state mismatch: slot {i} shape "
-                        f"{f[f'opt:{i}'].shape} != {tuple(leaf.shape)}")
-            for name in ckpt_params:
-                cur = self._params[name]
-                val = np.asarray(f[f"param:{name}"]).astype(cur.dtype)
-                self._params[name] = self._put_global(val, cur.sharding)
-            new_leaves = []
-            for i, leaf in enumerate(leaves):
-                arr = np.asarray(f[f"opt:{i}"]).astype(leaf.dtype)
-                new_leaves.append(self._put_global(arr, leaf.sharding))
-            self._opt_state = jax.tree_util.tree_unflatten(treedef,
-                                                           new_leaves)
-            self._step = int(f["meta:step"])
+                    f"optimizer state mismatch: slot {i} shape "
+                    f"{data[f'opt:{i}'].shape} != {tuple(leaf.shape)}")
+        for name in ckpt_params:
+            cur = self._params[name]
+            val = data[f"param:{name}"].astype(cur.dtype)
+            self._params[name] = self._put_global(val, cur.sharding)
+        new_leaves = []
+        for i, leaf in enumerate(leaves):
+            arr = data[f"opt:{i}"].astype(leaf.dtype)
+            new_leaves.append(self._put_global(arr, leaf.sharding))
+        self._opt_state = jax.tree_util.tree_unflatten(treedef,
+                                                       new_leaves)
+        self._step = int(data["meta:step"])
 
     def _resolve(self, name: str) -> str:
         if name in self._params:
@@ -1135,6 +1220,9 @@ class FFModel:
             self._repin_host()
         self._step += 1
         self._last_metric_sums = sums
+        # deterministic fault injection (no-op unless FF_FAULT is set):
+        # the elastic recovery matrix kills/hangs/slows real train loops
+        faults.on_step(self._step)
         return loss
 
     def fit(self, x, y, epochs: Optional[int] = None,
@@ -1191,6 +1279,7 @@ class FFModel:
                     if self._host_shardings:
                         self._repin_host()
                     self._step += 1
+                    faults.on_step(self._step)  # no-op without FF_FAULT
                     total_samples += bs
                     # keep metric sums on device; fetching here would fence
                     # the async dispatch pipeline every step

@@ -1,5 +1,6 @@
 """Elastic multi-process training: failure detection + restart-from-
-checkpoint.
+checkpoint, hardened with heartbeats, failure classification and a
+restart policy.
 
 Neither the reference nor Legion provides worker-failure recovery
 (SURVEY §5: failure detection "absent entirely" — a dead GASNet rank
@@ -10,37 +11,84 @@ optimizer state + step on process 0, and a restarted group re-forms the
 global mesh from scratch.  This launcher supervises the group:
 
   * spawn N worker processes (fresh coordinator port per attempt — a
-    dead gloo context cannot be rejoined);
-  * poll liveness; ANY worker exiting nonzero (or the attempt timing
-    out) fails the attempt — remaining workers are killed and reaped,
-    mirroring the all-or-nothing semantics of a jax.distributed group;
-  * relaunch up to ``max_restarts`` times.  Workers are responsible for
-    resuming: the standard pattern is "load the newest checkpoint if one
-    exists, else start fresh" (tests/_elastic_worker.py demonstrates it
-    and tests/test_elastic.py pins exact loss parity with an
-    uninterrupted run).
+    dead gloo context cannot be rejoined; the previous attempt's port is
+    never handed out again, and a coordinator "address already in use"
+    in a worker tail is classified as a ``spawn``-class transient);
+  * poll liveness AND progress: ANY worker exiting nonzero fails the
+    attempt (all-or-nothing, mirroring a jax.distributed group), and
+    when heartbeats are enabled (``hang_timeout_s``) an attempt in which
+    *no* rank advances its step for that long is killed early and
+    classified ``hung`` — a wedged XLA collective no longer burns the
+    full ``attempt_timeout_s``;
+  * classify every failed attempt (``crash`` / ``hung`` / ``spawn`` /
+    ``timeout``) and relaunch up to ``max_restarts`` times with
+    exponential backoff + seeded jitter between attempts.  A first
+    attempt in which EVERY rank exits nonzero essentially instantly
+    fails fast instead — an argv/config typo should not burn all
+    restarts (spawn-class failures never trip this);
+  * workers are responsible for resuming: the standard pattern is "load
+    the newest VALID checkpoint if one exists, else start fresh"
+    (:func:`latest_valid_checkpoint` / ``resilience.elastic_resume``;
+    tests/_elastic_worker.py demonstrates it and tests/test_elastic.py +
+    tests/test_faults.py pin every recovery path under injected faults —
+    see flexflow_tpu/faults.py and docs/elastic.md).
 
-Deliberately process-level: hung-worker detection is the attempt
-timeout, not an in-band heartbeat — a wedged XLA collective cannot be
-observed from inside the process anyway (the same reasoning as
-bench.py's killable-subprocess probe).
+Heartbeat protocol: the supervisor exports ``FF_HEARTBEAT_DIR`` (fresh
+per attempt); each rank stamps ``rank<r>.hb`` with its step via
+``resilience.Heartbeat``.  The monitor compares successive directory
+snapshots with its *own* clock — worker clocks are never compared.
+Detection starts at the first observed beat, so long cold compiles
+before step 0 are covered by ``attempt_timeout_s``, not mistaken for
+hangs.  Final per-rank steps are recorded in ``AttemptResult.rank_steps``
+(straggler forensics) even when hang detection is off.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import os
+import random
 import socket
 import subprocess
 import tempfile
 import time
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import (Callable, Collection, Dict, List, Mapping, Optional,
+                    Sequence)
+
+from ..faults import spawn_fail_requested
+from ..resilience import read_heartbeats
 
 
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
+def free_port(avoid: Collection[int] = ()) -> int:
+    """An OS-assigned free port, never one in ``avoid``.  Sockets for
+    avoided ports are held open until a fresh port is found, so the OS
+    cannot hand the same one straight back (fast successive elastic
+    attempts otherwise race exactly that way)."""
+    held: List[socket.socket] = []
+    try:
+        port = 0
+        for _ in range(16):
+            s = socket.socket()
+            held.append(s)
+            s.bind(("localhost", 0))
+            port = s.getsockname()[1]
+            if port not in avoid:
+                break
+        return port
+    finally:
+        for s in held:
+            s.close()
+
+
+def backoff_schedule(n: int, base_s: float = 0.5, max_s: float = 30.0,
+                     jitter: float = 0.5, seed: int = 0) -> List[float]:
+    """Delays (seconds) before restarts 1..n: exponential growth capped
+    at ``max_s``, times a seeded jitter factor in ``[1, 1+jitter)``.
+    Seeded => deterministic in tests, still decorrelated across
+    differently-seeded supervisors stampeding a shared resource."""
+    rng = random.Random(seed)
+    return [min(max_s, base_s * (2.0 ** i)) * (1.0 + jitter * rng.random())
+            for i in range(n)]
 
 
 @dataclasses.dataclass
@@ -54,16 +102,49 @@ class AttemptResult:
     # transient OSError from Popen while spawning (ADVICE r5): recorded
     # so the failure consumes a restart instead of aborting supervision
     spawn_error: Optional[str] = None
+    #: ``ok`` | ``crash`` | ``hung`` | ``spawn`` | ``timeout``
+    cause: str = "crash"
+    #: last heartbeat step per rank (straggler stats; empty when no rank
+    #: ever beat)
+    rank_steps: Dict[int, int] = dataclasses.field(default_factory=dict)
+    #: backoff slept after this attempt, before the next one
+    backoff_s: float = 0.0
 
 
 @dataclasses.dataclass
 class ElasticReport:
     success: bool
     attempts: List[AttemptResult]
+    #: attempt 0 was an instant all-rank crash; restarts were skipped
+    fail_fast: bool = False
 
     @property
     def restarts(self) -> int:
         return max(0, len(self.attempts) - 1)
+
+
+# substrings identifying a coordinator bind race in a worker tail; the
+# retry with a fresh (and different — see free_port(avoid)) port is
+# exactly what a restart does, so classify as spawn-class transient
+_ADDR_IN_USE = ("address already in use", "eaddrinuse")
+
+
+def _classify(spawn_error: Optional[str], hung: bool, timed_out: bool,
+              failed_rank: Optional[int], tails: Dict[int, str]) -> str:
+    if spawn_error is not None:
+        return "spawn"
+    if hung:
+        return "hung"
+    if timed_out:
+        return "timeout"
+    if failed_rank is None:
+        return "ok"
+    # the coordinator lives in rank 0, but the bind error can surface in
+    # any rank's jax.distributed bring-up — check the failed rank + rank 0
+    for r in {0, failed_rank}:
+        if any(pat in tails.get(r, "").lower() for pat in _ADDR_IN_USE):
+            return "spawn"
+    return "crash"
 
 
 def run_elastic(worker_argv: Callable[[int, int, int], Sequence[str]],
@@ -72,23 +153,53 @@ def run_elastic(worker_argv: Callable[[int, int, int], Sequence[str]],
                 attempt_timeout_s: float = 600.0,
                 poll_interval_s: float = 0.5,
                 env: Optional[Dict[str, str]] = None,
-                grace_kill_s: float = 5.0) -> ElasticReport:
+                grace_kill_s: float = 5.0,
+                per_rank_env: Optional[
+                    Callable[[int, int, int], Mapping[str, str]]] = None,
+                hang_timeout_s: Optional[float] = None,
+                heartbeat_root: Optional[str] = None,
+                backoff_base_s: float = 0.5,
+                backoff_max_s: float = 30.0,
+                backoff_jitter: float = 0.5,
+                backoff_seed: int = 0,
+                fail_fast_window_s: float = 2.0) -> ElasticReport:
     """Supervise ``num_processes`` workers; restart the whole group on
     any failure, at most ``max_restarts`` times.
 
     ``worker_argv(attempt, port, rank)`` builds each worker's argv; the
-    coordinator port is fresh per attempt.  ``env`` extends (not
-    replaces) os.environ; the launcher additionally exports
-    ``FF_ELASTIC_ATTEMPT`` so failure-injection tests can target one
-    attempt.  Returns an :class:`ElasticReport`; ``success`` means some
-    attempt had every worker exit 0."""
+    coordinator port is fresh per attempt (and never the immediately
+    preceding attempt's).  ``env`` extends (not replaces) os.environ for
+    every rank; ``per_rank_env(attempt, port, rank)`` adds rank-specific
+    variables on top (e.g. JAX_PROCESS_ID for script workers).  The
+    launcher additionally exports ``FF_ELASTIC_ATTEMPT`` (so
+    failure-injection — flexflow_tpu/faults.py — can target one attempt)
+    and a per-attempt ``FF_HEARTBEAT_DIR``.
+
+    ``hang_timeout_s`` enables early hang detection: once any rank has
+    heartbeat, an interval of that length in which no rank's step
+    advances kills the attempt with cause ``hung`` (vs waiting out
+    ``attempt_timeout_s``).  Between failed attempts the supervisor
+    sleeps per :func:`backoff_schedule`; an instant all-rank nonzero
+    exit on attempt 0 (within ``fail_fast_window_s``, cause ``crash``)
+    aborts supervision immediately with ``fail_fast=True``.
+
+    Returns an :class:`ElasticReport`; ``success`` means some attempt
+    had every worker exit 0."""
     attempts: List[AttemptResult] = []
+    hb_root = heartbeat_root or tempfile.mkdtemp(prefix="ff_hb_")
+    backoffs = backoff_schedule(max_restarts, backoff_base_s,
+                                backoff_max_s, backoff_jitter, backoff_seed)
+    prev_port: Optional[int] = None
     for attempt in range(max_restarts + 1):
-        port = free_port()
+        port = free_port(avoid=() if prev_port is None else (prev_port,))
+        prev_port = port
+        hb_dir = os.path.join(hb_root, f"attempt{attempt}")
+        os.makedirs(hb_dir, exist_ok=True)
         worker_env = dict(os.environ)
         if env:
             worker_env.update(env)
         worker_env["FF_ELASTIC_ATTEMPT"] = str(attempt)
+        worker_env["FF_HEARTBEAT_DIR"] = hb_dir
         procs: List[subprocess.Popen] = []
         # per-rank log FILES, not pipes: an undrained pipe blocks the
         # worker after ~64 KB of output (a verbose XLA warning dump
@@ -98,21 +209,31 @@ def run_elastic(worker_argv: Callable[[int, int, int], Sequence[str]],
         t0 = time.monotonic()
         failed_rank: Optional[int] = None
         timed_out = False
+        hung = False
         spawn_error: Optional[str] = None
+        last_hb: Dict[int, int] = {}
+        last_progress = t0
         try:
             # a transient OSError (fd exhaustion, ENOMEM, a briefly
             # missing interpreter on shared storage) from open/Popen is
             # an attempt FAILURE, not a supervision abort: record it,
             # reap whatever spawned, and let the restart loop retry
             try:
+                if spawn_fail_requested(worker_env, attempt):
+                    raise OSError(
+                        f"injected spawn_fail_attempt:{attempt} (FF_FAULT)")
                 for rank in range(num_processes):
                     lf = open(os.path.join(logdir, f"rank{rank}.log"),
                               "w+b")
                     logs.append(lf)
+                    env_r = worker_env
+                    if per_rank_env is not None:
+                        env_r = dict(worker_env)
+                        env_r.update(per_rank_env(attempt, port, rank))
                     procs.append(subprocess.Popen(
                         list(worker_argv(attempt, port, rank)),
                         stdout=lf, stderr=subprocess.STDOUT,
-                        env=worker_env))
+                        env=env_r))
             except OSError as e:
                 failed_rank = len(procs)  # the rank that failed to spawn
                 spawn_error = f"{type(e).__name__}: {e}"
@@ -125,10 +246,29 @@ def run_elastic(worker_argv: Callable[[int, int, int], Sequence[str]],
                     break
                 if all(c == 0 for c in codes):
                     break
-                if time.monotonic() - t0 > attempt_timeout_s:
+                now = time.monotonic()
+                if now - t0 > attempt_timeout_s:
                     timed_out = True
                     break
+                if hang_timeout_s is not None:  # no monitor, no disk I/O
+                    hb = read_heartbeats(hb_dir)
+                    if hb != last_hb:    # a new rank appeared or a step
+                        last_hb = hb     # advanced: that is progress
+                        last_progress = now
+                    elif hb and now - last_progress > hang_timeout_s:
+                        hung = True
+                        break
                 time.sleep(poll_interval_s)
+            if (attempt == 0 and failed_rank is not None
+                    and spawn_error is None
+                    and time.monotonic() - t0 <= fail_fast_window_s):
+                # possible config-error signature: give the remaining
+                # ranks the rest of the window to exit ON THEIR OWN —
+                # only an all-rank self-exit counts (a rank we kill
+                # below would be indistinguishable from a crasher)
+                while (any(p.poll() is None for p in procs)
+                        and time.monotonic() - t0 <= fail_fast_window_s):
+                    time.sleep(0.05)
         finally:
             for p in procs:
                 if p.poll() is None:
@@ -152,36 +292,74 @@ def run_elastic(worker_argv: Callable[[int, int, int], Sequence[str]],
                 tails[r] = "<log unavailable>"
             finally:
                 lf.close()
+        cause = _classify(spawn_error, hung, timed_out, failed_rank, tails)
         result = AttemptResult(
             port=port,
             returncodes=[p.returncode for p in procs],
-            failed_rank=failed_rank, timed_out=timed_out,
+            failed_rank=failed_rank,
+            timed_out=timed_out or hung,
             elapsed_s=round(time.monotonic() - t0, 3), tails=tails,
-            spawn_error=spawn_error)
+            spawn_error=spawn_error, cause=cause,
+            rank_steps=read_heartbeats(hb_dir))
         attempts.append(result)
-        if not timed_out and failed_rank is None \
-                and all(c == 0 for c in result.returncodes):
+        if cause == "ok" and all(c == 0 for c in result.returncodes):
             return ElasticReport(True, attempts)
+        if (attempt == 0 and cause == "crash"
+                and result.elapsed_s <= fail_fast_window_s
+                and result.returncodes
+                and all(c not in (0, None) and c >= 0
+                        for c in result.returncodes)):
+            # every rank self-exited nonzero near-instantly (negative
+            # codes are our own kills, excluded): argv/config error —
+            # retrying max_restarts times would yield the same failure
+            return ElasticReport(False, attempts, fail_fast=True)
+        if attempt < max_restarts and backoffs[attempt] > 0:
+            result.backoff_s = round(backoffs[attempt], 3)
+            time.sleep(backoffs[attempt])
     return ElasticReport(False, attempts)
 
 
 def latest_checkpoint(directory: str, prefix: str = "elastic") -> Optional[str]:
-    """Newest ``<prefix>_step*.npz`` checkpoint in ``directory`` (the
-    worker-side half of the resume pattern), or None.  Sorted by the
-    step number embedded in the name, not mtime — ranks may observe
-    different mtimes on shared storage."""
+    """Newest ``<prefix>_step*.npz`` checkpoint in ``directory``, or
+    None.  Sorted by the step number embedded in the name, not mtime —
+    ranks may observe different mtimes on shared storage.  Trusts the
+    file blindly; the elastic resume path should prefer
+    :func:`latest_valid_checkpoint`."""
+    found = _step_checkpoints(directory, prefix)
+    return found[0][1] if found else None
+
+
+def latest_valid_checkpoint(directory: str,
+                            prefix: str = "elastic") -> Optional[str]:
+    """Newest checkpoint in ``directory`` that passes
+    ``resilience.verify_checkpoint`` (full read + manifest CRCs),
+    falling back step by step past corrupt/truncated files.  A
+    bit-rotted newest checkpoint on shared storage therefore costs one
+    save interval instead of wedging every restart attempt in a
+    resume-crash loop."""
+    from ..resilience import verify_checkpoint
+    for _, path in _step_checkpoints(directory, prefix):
+        if verify_checkpoint(path):
+            return path
+    return None
+
+
+def _step_checkpoints(directory: str, prefix: str):
+    """``(step, path)`` for every ``<prefix>_step<N>.npz``, newest first."""
     try:
         names = os.listdir(directory)
     except OSError:
-        return None
-    best, best_step = None, -1
+        return []
+    found = []
     for n in names:
         if not (n.startswith(prefix + "_step") and n.endswith(".npz")):
             continue
+        if n.endswith(".tmp.npz"):
+            continue  # unpublished partial write, never a resume source
         try:
             step = int(n[len(prefix + "_step"):-len(".npz")])
         except ValueError:
             continue
-        if step > best_step:
-            best, best_step = n, step
-    return os.path.join(directory, best) if best else None
+        found.append((step, os.path.join(directory, n)))
+    found.sort(key=lambda sp: sp[0], reverse=True)
+    return found

@@ -1,0 +1,245 @@
+"""Worker-side resilience helpers for elastic training.
+
+Three small pieces, shared by the supervisor
+(:mod:`flexflow_tpu.parallel.elastic`), the checkpoint layer
+(:meth:`FFModel.save_checkpoint` / :meth:`load_checkpoint`) and elastic
+worker scripts (``tests/_elastic_worker.py``, ``flexflow-tpu elastic``):
+
+* **Heartbeats** — each rank stamps ``<dir>/rank<r>.hb`` with its step
+  number once per step (atomic tmp+rename, so the supervisor never reads
+  a torn write).  The supervisor only compares *contents across reads
+  with its own clock* — the monotonic/wall times in the file are
+  per-process and recorded for human forensics, never compared across
+  machines.
+* **Checkpoint manifest + verification** — ``build_manifest`` embeds a
+  per-array CRC32 table (plus step and format version) under the
+  ``meta:manifest`` key of the checkpoint ``.npz``; ``verify_checkpoint``
+  re-reads a file end to end and checks every CRC, turning "is this
+  checkpoint trustworthy?" into a cheap local question the restart path
+  can ask *before* resuming from it.
+* **Atomic publish** — ``_atomic_savez`` is the single tmp+rename writer
+  used by both ``save_checkpoint`` and keras ``save_weights`` (they had
+  drifted into two copies).
+
+Import-light on purpose: numpy + stdlib only, never jax — the supervisor
+process must stay cheap and the helpers must work before/without a jax
+runtime.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import zlib
+from typing import Dict, Optional
+
+import numpy as np
+
+from . import faults
+
+#: npz key holding the JSON manifest (kept in ``meta:`` space alongside
+#: ``meta:step`` so param/opt key enumeration is unaffected)
+MANIFEST_KEY = "meta:manifest"
+MANIFEST_VERSION = 1
+
+
+class CorruptNpzError(RuntimeError):
+    """A ``.npz`` archive (checkpoint or dataset) that cannot be read —
+    truncated, bit-rotted, or failing its manifest CRCs."""
+
+
+class CorruptCheckpointError(CorruptNpzError):
+    """A checkpoint that failed verification; the raiser names the path
+    and the fallback (``latest_valid_checkpoint`` / ``elastic_resume``)."""
+
+
+# ----------------------------------------------------------------------
+# atomic publish (shared by model.save_checkpoint and keras save_weights)
+# ----------------------------------------------------------------------
+def _atomic_savez(final: str, arrays: Dict[str, np.ndarray]) -> str:
+    """Write ``arrays`` to ``final`` (.npz) via tmp + rename: a crash or
+    kill mid-write never leaves a truncated file at the published name.
+    The tmp keeps the ``.npz`` suffix because ``np.savez`` appends it to
+    suffix-less paths."""
+    assert final.endswith(".npz"), final
+    tmp = final[:-len(".npz")] + ".tmp.npz"
+    np.savez(tmp, **arrays)
+    os.replace(tmp, final)
+    return final
+
+
+# ----------------------------------------------------------------------
+# checkpoint manifest
+# ----------------------------------------------------------------------
+def _crc(a: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(a).tobytes())
+
+
+def build_manifest(arrays: Dict[str, np.ndarray], step: int) -> str:
+    """JSON manifest for a checkpoint's arrays: per-array CRC32 + shape +
+    dtype, the step, and a format version."""
+    return json.dumps({
+        "format_version": MANIFEST_VERSION,
+        "step": int(step),
+        "arrays": {
+            k: {"crc32": _crc(np.asarray(v)),
+                "shape": list(np.asarray(v).shape),
+                "dtype": str(np.asarray(v).dtype)}
+            for k, v in arrays.items()},
+    }, sort_keys=True)
+
+
+def verify_manifest(data: Dict[str, np.ndarray], path: str = "<npz>") -> None:
+    """Check loaded checkpoint ``data`` against its embedded manifest.
+    Manifest-less archives (pre-manifest checkpoints) pass — readability
+    was already proven by loading them.  Raises
+    :class:`CorruptCheckpointError` on any mismatch."""
+    if MANIFEST_KEY not in data:
+        return
+    try:
+        man = json.loads(str(np.asarray(data[MANIFEST_KEY])))
+        version = int(man["format_version"])
+        entries = man["arrays"]
+    except (ValueError, KeyError, TypeError) as e:
+        raise CorruptCheckpointError(
+            f"checkpoint {path!r} has an unreadable manifest "
+            f"({type(e).__name__}: {e})") from e
+    if version > MANIFEST_VERSION:
+        raise CorruptCheckpointError(
+            f"checkpoint {path!r} has manifest format_version {version}; "
+            f"this build understands <= {MANIFEST_VERSION}")
+    payload = {k: v for k, v in data.items() if k != MANIFEST_KEY}
+    if set(entries) != set(payload):
+        raise CorruptCheckpointError(
+            f"checkpoint {path!r} manifest names "
+            f"{len(entries)} arrays but the archive holds {len(payload)}")
+    for k, v in payload.items():
+        if _crc(v) != int(entries[k]["crc32"]):
+            raise CorruptCheckpointError(
+                f"checkpoint {path!r} failed CRC verification for "
+                f"array {k!r} — the file is corrupt; an elastic resume "
+                f"should fall back to the next-newest valid checkpoint "
+                f"(latest_valid_checkpoint / elastic_resume)")
+
+
+def read_npz_verified(path: str, what: str = "checkpoint"
+                      ) -> Dict[str, np.ndarray]:
+    """Read a whole ``.npz`` into host arrays, translating the opaque
+    low-level failures of a truncated/corrupt archive
+    (``zipfile.BadZipFile``, bare ``ValueError``/``OSError``) into a
+    :class:`CorruptCheckpointError` that names the path, then checking
+    the embedded manifest when present."""
+    import zipfile
+    try:
+        with np.load(path, allow_pickle=False) as f:
+            data = {k: np.asarray(f[k]) for k in f.files}
+    except (zipfile.BadZipFile, zlib.error, ValueError, OSError, EOFError,
+            KeyError) as e:
+        raise CorruptCheckpointError(
+            f"{what} {path!r} is corrupt or truncated "
+            f"({type(e).__name__}: {e}); if this is an elastic run, "
+            f"resume from the next-newest valid file via "
+            f"latest_valid_checkpoint() / elastic_resume()") from e
+    verify_manifest(data, path)
+    return data
+
+
+def verify_checkpoint(path: str) -> bool:
+    """True iff ``path`` is a readable checkpoint whose manifest (when
+    present) verifies.  Reads the whole file — that is the point: a
+    verdict cheaper than reading cannot rule out truncation."""
+    try:
+        read_npz_verified(path)
+        return True
+    except CorruptNpzError:
+        return False
+
+
+# ----------------------------------------------------------------------
+# heartbeats
+# ----------------------------------------------------------------------
+class Heartbeat:
+    """Per-rank progress stamp.  Workers call :meth:`beat` once per
+    completed step; the supervisor's hang monitor reads the directory and
+    kills the attempt when *no* rank's step advances for
+    ``hang_timeout_s``.  Disabled (every call a no-op) when no directory
+    is configured, so worker code can call it unconditionally.
+
+    File protocol: ``<dir>/rank<r>.hb`` containing one line
+    ``"<step> <monotonic> <wall>"``, published atomically.
+    """
+
+    def __init__(self, directory: Optional[str] = None,
+                 rank: Optional[int] = None):
+        self.dir = directory if directory is not None \
+            else os.environ.get("FF_HEARTBEAT_DIR")
+        self.rank = int(rank) if rank is not None else 0
+        if rank is not None:
+            faults.set_rank(rank)  # one registration point for workers
+        if self.dir:
+            os.makedirs(self.dir, exist_ok=True)
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.dir)
+
+    def beat(self, step: int) -> None:
+        if not self.dir:
+            return
+        final = os.path.join(self.dir, f"rank{self.rank}.hb")
+        tmp = final + ".tmp"
+        try:
+            with open(tmp, "w") as fh:
+                fh.write(f"{int(step)} {time.monotonic():.3f} "
+                         f"{time.time():.3f}\n")
+            os.replace(tmp, final)
+        except OSError:
+            pass  # a failed beat must never kill training
+
+
+def read_heartbeats(directory: str) -> Dict[int, int]:
+    """Supervisor side: ``{rank: last_step}`` from a heartbeat dir.
+    Unparseable/partial files are skipped (the next beat replaces them)."""
+    out: Dict[int, int] = {}
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return out
+    for n in names:
+        if not (n.startswith("rank") and n.endswith(".hb")):
+            continue
+        try:
+            rank = int(n[len("rank"):-len(".hb")])
+            with open(os.path.join(directory, n)) as fh:
+                out[rank] = int(fh.read().split()[0])
+        except (OSError, ValueError, IndexError):
+            continue
+    return out
+
+
+# ----------------------------------------------------------------------
+# the standard worker resume pattern
+# ----------------------------------------------------------------------
+def elastic_resume(model, workdir: str, prefix: str = "elastic"
+                   ) -> Optional[str]:
+    """Load the newest *valid* checkpoint from ``workdir`` into
+    ``model`` (skipping corrupt/truncated files — a bit-rotted newest
+    checkpoint costs one save interval, not the whole job).  Returns the
+    path resumed from, or None for a fresh start.
+
+    Probes candidates newest-first with a single read + CRC pass each
+    and restores straight from the winning read — a multi-GB checkpoint
+    on shared storage is not read twice per rank at the exact moment the
+    job is recovering (vs ``latest_valid_checkpoint`` +
+    ``load_checkpoint``, which would verify then re-read)."""
+    from .parallel.elastic import _step_checkpoints
+    model.wait_for_checkpoint()  # never read under a pending writer
+    for _, path in _step_checkpoints(workdir, prefix):
+        try:
+            data = read_npz_verified(path, what="checkpoint")
+        except CorruptNpzError:
+            continue
+        model._restore_from_host(data)
+        return path
+    return None

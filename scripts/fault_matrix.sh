@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# Run the full fault-injection matrix locally with per-case timeouts.
+#
+# Two halves (docs/elastic.md):
+#   fast  — tests/test_faults.py: supervisor-level faults with real OS
+#           processes but no jax workers (also run by tier-1 via the
+#           `faults` marker)
+#   slow  — tests/test_elastic.py: multi-process jax workers, one
+#           recovery + loss-parity case per FF_FAULT kind
+#
+# Usage: scripts/fault_matrix.sh [--fast-only]
+# Exit: nonzero if any case fails or times out.
+
+set -u
+cd "$(dirname "$0")/.."
+
+FAST_TIMEOUT=${FAST_TIMEOUT:-180}
+SLOW_TIMEOUT=${SLOW_TIMEOUT:-900}
+
+declare -a cases=(
+  "$FAST_TIMEOUT tests/test_faults.py"
+)
+if [ "${1:-}" != "--fast-only" ]; then
+  cases+=(
+    "$SLOW_TIMEOUT tests/test_elastic.py::test_crash_restart_resume"
+    "$SLOW_TIMEOUT tests/test_elastic.py::test_hang_detected_by_heartbeats_and_recovered"
+    "$SLOW_TIMEOUT tests/test_elastic.py::test_corrupt_newest_checkpoint_falls_back"
+    "$SLOW_TIMEOUT tests/test_elastic.py::test_spawn_fault_consumes_restart_then_recovers"
+    "$SLOW_TIMEOUT tests/test_elastic.py::test_exhausted_restarts_reports_failure"
+    "$SLOW_TIMEOUT tests/test_elastic.py::test_spawn_failure_consumes_restart"
+  )
+fi
+
+# each pytest invocation is its own session: keep the in-process
+# compilation cache across cases instead of re-clearing it every time
+# (tests/conftest.py clears it per session by default)
+export FF_TEST_KEEP_CACHE=1
+
+fails=0
+for entry in "${cases[@]}"; do
+  t=${entry%% *}
+  case=${entry#* }
+  echo "=== fault-matrix: $case (timeout ${t}s) ==="
+  timeout -k 10 "$t" env JAX_PLATFORMS=cpu \
+    python -m pytest "$case" -q -p no:cacheprovider
+  rc=$?
+  if [ $rc -ne 0 ]; then
+    [ $rc -ge 124 ] && echo "TIMEOUT after ${t}s: $case"
+    echo "FAIL (rc=$rc): $case"
+    fails=$((fails + 1))
+  fi
+done
+
+echo
+if [ $fails -ne 0 ]; then
+  echo "fault matrix: $fails case(s) FAILED"
+  exit 1
+fi
+echo "fault matrix: all cases passed"

@@ -1,17 +1,26 @@
-"""Worker for the elastic-recovery test (launched via
+"""Worker for the elastic-recovery tests (launched via
 flexflow_tpu.parallel.elastic.run_elastic by tests/test_elastic.py).
 
-Demonstrates the standard elastic resume pattern: load the newest
-checkpoint if one exists (params + optimizer state + step), train to
-TOTAL_STEPS with per-step deterministic batches, checkpointing every
-CKPT_EVERY steps.  Failure injection: rank KILL_RANK dies hard
-(os._exit) after KILL_AFTER_STEP steps on attempt 0 only
-(FF_ELASTIC_ATTEMPT is exported by the launcher) — a later attempt must
-resume from the last checkpoint and finish with the exact losses of an
-uninterrupted run.
+Demonstrates the standard hardened elastic resume pattern
+(docs/elastic.md):
+
+* ``resilience.Heartbeat`` — stamp per-rank progress each step (the
+  supervisor's hang monitor reads it; also registers this rank with the
+  fault-injection switchboard);
+* ``resilience.elastic_resume`` — load the newest *valid* checkpoint
+  (skipping corrupt/truncated files), else start fresh;
+* train to TOTAL_STEPS with per-step deterministic batches,
+  checkpointing every CKPT_EVERY steps.
+
+Failure injection is entirely ``FF_FAULT``-driven (flexflow_tpu/faults.py)
+— the tests export e.g. ``FF_FAULT=kill_at_step:3,rank=1`` and the hooks
+inside ``FFModel.train_batch`` / ``save_checkpoint`` fire them; the
+worker contains no test-specific crash code.
 
 argv: <coordinator_port> <rank> <nprocs> <workdir> <devices_per_proc>
-Writes "<workdir>/final_<rank>.txt" with the last-step loss.
+Writes "<workdir>/final_<rank>.txt" with the full-precision (repr) last
+loss and "<workdir>/resume_r<rank>_a<attempt>.txt" with the checkpoint
+path resumed from ("fresh" for a cold start).
 """
 
 import os
@@ -22,8 +31,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 BATCH = 32
 TOTAL_STEPS = 6
 CKPT_EVERY = 2
-KILL_RANK = 1
-KILL_AFTER_STEP = 3
 
 
 def build_model():
@@ -65,37 +72,46 @@ def main():
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # no persistent compile cache here: XLA cannot serialize
+    # multi-process CPU executables ("Multiprocess computations aren't
+    # implemented on the CPU backend"), so workers compile cold
 
     from flexflow_tpu.parallel.distributed import (coordination_barrier,
                                                    initialize_distributed)
-    from flexflow_tpu.parallel.elastic import latest_checkpoint
+    from flexflow_tpu.resilience import Heartbeat, elastic_resume
 
     assert initialize_distributed(coordinator_address=f"localhost:{port}",
                                   num_processes=nprocs, process_id=rank)
+
+    # dir comes from FF_HEARTBEAT_DIR (exported per attempt by the
+    # supervisor); also registers this rank for rank-scoped FF_FAULT specs
+    hb = Heartbeat(rank=rank)
 
     model = build_model()
     xd, yd = step_batch(0)
     model.warmup_compile(xd, yd)
     coordination_barrier("ff_elastic_compiled", timeout_s=240)
 
-    ckpt = latest_checkpoint(workdir)
-    if ckpt is not None:
-        model.load_checkpoint(ckpt)
+    resumed = elastic_resume(model, workdir)
+    with open(os.path.join(workdir, f"resume_r{rank}_a{attempt}.txt"),
+              "w") as f:
+        f.write(resumed or "fresh")
+    hb.beat(model._step)
 
     while model._step < TOTAL_STEPS:
         step = model._step
         xd, yd = step_batch(step)
+        # FF_FAULT kill/hang/slow hooks fire inside train_batch
         loss = float(model.train_batch(xd, yd))
         done = model._step  # train_batch increments
+        hb.beat(done)
         if done % CKPT_EVERY == 0 and done < TOTAL_STEPS:
+            # FF_FAULT corrupt_ckpt fires inside save_checkpoint
             model.save_checkpoint(
                 os.path.join(workdir, f"elastic_step{done}"))
-        if (attempt == 0 and rank == KILL_RANK
-                and done == KILL_AFTER_STEP):
-            os._exit(17)  # simulated hard crash (no cleanup, no excepthook)
 
     with open(os.path.join(workdir, f"final_{rank}.txt"), "w") as f:
-        f.write(f"{loss:.9f}\n")
+        f.write(repr(loss) + "\n")  # repr: bit-exact float round-trip
 
 
 if __name__ == "__main__":

@@ -56,3 +56,12 @@ jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
 # a truncated entry behind
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+def pytest_configure(config):
+    # tier-1 runs the fast fault matrix (tests/test_faults.py: real OS
+    # processes, no jax workers); anything needing >30 s — the
+    # multi-process jax recovery runs — carries the `slow` marker instead
+    config.addinivalue_line(
+        "markers",
+        "faults: fault-injection matrix (fast, supervisor-level; tier-1)")

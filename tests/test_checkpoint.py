@@ -191,3 +191,92 @@ def test_async_checkpoint_roundtrip(tmp_path):
     # a second async save then an immediate load: load joins the writer
     m.save_checkpoint(ckpt, async_write=True)
     m.load_checkpoint(ckpt)
+
+
+def test_checkpoint_embeds_verifying_manifest(tmp_path):
+    """save_checkpoint embeds a per-array CRC32 manifest (step + format
+    version) that resilience.verify_checkpoint accepts, and that covers
+    every array in the archive."""
+    import json
+
+    from flexflow_tpu.resilience import MANIFEST_KEY, verify_checkpoint
+
+    a = _model()
+    x, y = _data()
+    a.train_batch(x, y)
+    ckpt = os.path.join(tmp_path, "man.npz")
+    a.save_checkpoint(ckpt)
+    assert verify_checkpoint(ckpt)
+    with np.load(ckpt) as f:
+        assert MANIFEST_KEY in f.files
+        man = json.loads(str(np.asarray(f[MANIFEST_KEY])))
+        assert man["format_version"] == 1
+        assert man["step"] == 1
+        assert set(man["arrays"]) == set(f.files) - {MANIFEST_KEY}
+
+
+def test_corrupt_checkpoint_raises_clear_error(tmp_path):
+    """A truncated checkpoint surfaces as CorruptCheckpointError naming
+    the path and the fallback — not a bare zipfile.BadZipFile — and the
+    model's state is untouched."""
+    import pytest
+
+    from flexflow_tpu import faults
+    from flexflow_tpu.resilience import CorruptCheckpointError
+
+    x, y = _data()
+    a = _model()
+    a.train_batch(x, y)
+    ckpt = os.path.join(tmp_path, "trunc.npz")
+    a.save_checkpoint(ckpt)
+    faults.corrupt_file(ckpt)
+    before = {k: np.asarray(v) for k, v in a._params.items()}
+    step_before = a._step
+    with pytest.raises(CorruptCheckpointError) as ei:
+        a.load_checkpoint(ckpt)
+    assert "trunc.npz" in str(ei.value)
+    assert "latest_valid_checkpoint" in str(ei.value)
+    assert a._step == step_before
+    for k in before:
+        np.testing.assert_array_equal(before[k], np.asarray(a._params[k]))
+
+
+def test_stale_tmp_cleanup_and_retention(tmp_path):
+    """save_checkpoint sweeps orphaned *.tmp.npz siblings (a worker
+    killed mid-np.savez leaves them forever) and keep_last=K prunes the
+    step family so elastic runs don't fill disks."""
+    a = _model()
+    x, y = _data()
+    # orphan from a previous killed writer + an alien tmp that must stay
+    stale = tmp_path / "elastic_step1.tmp.npz"
+    stale.write_bytes(b"partial write")
+    alien = tmp_path / "other_family.tmp.npz"
+    alien.write_bytes(b"not ours")
+    for _ in range(4):
+        a.train_batch(x, y)
+        a.save_checkpoint(
+            os.path.join(tmp_path, f"elastic_step{a._step}"), keep_last=2)
+    names = sorted(os.listdir(tmp_path))
+    assert not stale.exists(), names
+    assert alien.exists(), names  # scoped sweep: other families untouched
+    assert [n for n in names if n.endswith(".npz") and "elastic" in n] == \
+        ["elastic_step3.npz", "elastic_step4.npz"]
+
+
+def test_save_weights_shares_atomic_writer(tmp_path):
+    """keras save_weights publishes through the same
+    resilience._atomic_savez as save_checkpoint: no tmp file survives a
+    successful save, and the weights round-trip."""
+    from flexflow_tpu import keras as fk
+
+    cfg = ff.FFConfig(batch_size=16, compute_dtype="float32")
+    m = fk.Sequential(
+        [fk.layers.Dense(8, activation="relu", input_shape=(6,)),
+         fk.layers.Dense(3)])
+    m.compile(fk.SGD(), loss="sparse_categorical_crossentropy",
+              metrics=[], config=cfg)
+    path = os.path.join(tmp_path, "w.npz")
+    m.save_weights(path)
+    assert os.path.exists(path)
+    assert not os.path.exists(os.path.join(tmp_path, "w.tmp.npz"))
+    m.load_weights(path)

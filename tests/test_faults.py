@@ -1,0 +1,346 @@
+"""Fault-injection matrix for the hardened elastic stack — the FAST
+half: supervisor-level behavior exercised with real OS processes but no
+jax workers, so it runs in tier-1 (marker ``faults``).  One test per
+FF_FAULT kind, plus the restart-policy invariants (seeded backoff,
+fail-fast, port hygiene, addr-in-use classification) and the checkpoint
+integrity layer (manifest CRCs, corrupt-file fallback, corrupt-dataset
+errors).
+
+The multi-process jax half — loss-parity recovery for every fault kind —
+is tests/test_elastic.py (``slow``).  scripts/fault_matrix.sh runs both.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import faults
+from flexflow_tpu.parallel.elastic import (backoff_schedule, free_port,
+                                           latest_checkpoint,
+                                           latest_valid_checkpoint,
+                                           run_elastic)
+from flexflow_tpu.resilience import (Heartbeat, _atomic_savez,
+                                     build_manifest, CorruptNpzError,
+                                     MANIFEST_KEY, read_heartbeats,
+                                     verify_checkpoint)
+
+pytestmark = pytest.mark.faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FAULTS_PY = os.path.join(REPO, "flexflow_tpu", "faults.py")
+
+
+# ----------------------------------------------------------------------
+# FF_FAULT grammar
+# ----------------------------------------------------------------------
+def test_parse_grammar():
+    specs = faults.parse_faults(
+        "kill_at_step:7,rank=1;corrupt_ckpt:latest,attempt=*;"
+        "slow_rank:0,delay=0.5;spawn_fail_attempt:2")
+    assert [s.kind for s in specs] == [
+        "kill_at_step", "corrupt_ckpt", "slow_rank", "spawn_fail_attempt"]
+    kill, corrupt, slow, spawn = specs
+    assert kill.arg == "7" and kill.rank == 1
+    assert kill.attempt == 0          # default: attempt 0 only
+    assert corrupt.attempt is None    # attempt=* -> every attempt
+    assert slow.extras["delay"] == "0.5"
+    assert spawn.attempt == 2         # the arg IS the attempt
+    assert faults.parse_faults("") == [] and faults.parse_faults(None) == []
+
+
+def test_parse_rejects_typos():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        faults.parse_faults("kill_at_stpe:7")
+    with pytest.raises(ValueError, match="missing"):
+        faults.parse_faults("kill_at_step")
+    with pytest.raises(ValueError, match="unknown fault qualifier"):
+        faults.parse_faults("kill_at_step:7,bogus=1")
+
+
+@pytest.fixture
+def fault_env(monkeypatch):
+    """Install an FF_FAULT plan for the current process, undone (cache
+    included) at teardown."""
+    def install(value, rank=None):
+        monkeypatch.setenv("FF_FAULT", value)
+        faults.reset()
+        if rank is not None:
+            faults.set_rank(rank)
+    yield install
+    faults.reset()
+
+
+def test_slow_rank_hook_delays(fault_env):
+    fault_env("slow_rank:0,delay=0.05", rank=0)
+    t0 = time.monotonic()
+    faults.on_step(1)
+    assert time.monotonic() - t0 >= 0.05
+    # other ranks unaffected
+    faults.set_rank(1)
+    t0 = time.monotonic()
+    faults.on_step(1)
+    assert time.monotonic() - t0 < 0.04
+
+
+def test_kill_hook_fires_in_subprocess(tmp_path):
+    """kill_at_step exits hard with code 17 at exactly the target step,
+    honoring rank scoping.  faults.py is loaded standalone (importlib)
+    so the worker never pays the flexflow_tpu package import."""
+    loader = textwrap.dedent(f"""
+        import importlib.util, sys
+        spec = importlib.util.spec_from_file_location("ff_faults",
+                                                      {FAULTS_PY!r})
+        m = importlib.util.module_from_spec(spec)
+        sys.modules["ff_faults"] = m  # dataclass machinery resolves it
+        spec.loader.exec_module(m)
+        m.set_rank(int(sys.argv[1]))
+        for s in range(1, 5):
+            m.on_step(s)
+        print("survived")
+    """)
+    env = dict(os.environ, FF_FAULT="kill_at_step:3,rank=1")
+    hit = subprocess.run([sys.executable, "-c", loader, "1"], env=env,
+                         capture_output=True, text=True, timeout=30)
+    assert hit.returncode == faults.KILL_EXIT_CODE == 17
+    assert "survived" not in hit.stdout
+    assert "injected kill at step 3" in hit.stderr
+    miss = subprocess.run([sys.executable, "-c", loader, "0"], env=env,
+                          capture_output=True, text=True, timeout=30)
+    assert miss.returncode == 0 and "survived" in miss.stdout
+
+
+# ----------------------------------------------------------------------
+# checkpoint integrity: manifest CRCs + newest-valid fallback
+# ----------------------------------------------------------------------
+def _write_ckpt(path, seed=0, step=2):
+    rng = np.random.default_rng(seed)
+    flat = {"param:w": rng.standard_normal((4, 3)).astype(np.float32),
+            "meta:step": np.asarray(step, np.int64)}
+    flat[MANIFEST_KEY] = np.asarray(build_manifest(flat, step))
+    return _atomic_savez(path, flat)
+
+
+def test_corrupt_file_fails_verification(tmp_path):
+    p = _write_ckpt(str(tmp_path / "ck.npz"))
+    assert verify_checkpoint(p)
+    faults.corrupt_file(p)  # truncate: a writer killed mid-write
+    assert not verify_checkpoint(p)
+
+
+def test_manifest_catches_silent_bitrot(tmp_path):
+    """A zip-valid archive whose array bytes do not match the manifest
+    CRCs (bitrot the container cannot see) must fail verification."""
+    rng = np.random.default_rng(0)
+    good = rng.standard_normal((4, 3)).astype(np.float32)
+    tampered = good.copy()
+    tampered[0, 0] += 1.0
+    flat = {"param:w": tampered, "meta:step": np.asarray(2, np.int64)}
+    # manifest describes the ORIGINAL bytes; archive holds tampered ones
+    manifest = build_manifest(
+        {"param:w": good, "meta:step": flat["meta:step"]}, 2)
+    flat[MANIFEST_KEY] = np.asarray(manifest)
+    p = _atomic_savez(str(tmp_path / "rot.npz"), flat)
+    assert not verify_checkpoint(p)
+
+
+def test_latest_valid_skips_corrupt_newest(tmp_path):
+    """The corrupt-newest-checkpoint wedge: latest_checkpoint trusts the
+    newest file, latest_valid_checkpoint falls back one save interval."""
+    ok = _write_ckpt(str(tmp_path / "elastic_step2.npz"), step=2)
+    bad = _write_ckpt(str(tmp_path / "elastic_step4.npz"), step=4)
+    faults.corrupt_file(bad)
+    assert latest_checkpoint(str(tmp_path)) == bad
+    assert latest_valid_checkpoint(str(tmp_path)) == ok
+    faults.corrupt_file(ok)  # everything corrupt -> fresh start, not crash
+    assert latest_valid_checkpoint(str(tmp_path)) is None
+
+
+def test_corrupt_dataset_raises_clear_error(tmp_path):
+    from flexflow_tpu.data.dataloader import load_numpy_dataset
+    p = str(tmp_path / "data.npz")
+    np.savez(p, x0=np.zeros((4, 2), np.float32),
+             y0=np.zeros((4, 1), np.int32))
+    faults.corrupt_file(p)
+    with pytest.raises(CorruptNpzError, match="data.npz"):
+        load_numpy_dataset(p)
+    with pytest.raises(FileNotFoundError):  # missing is NOT "corrupt"
+        load_numpy_dataset(str(tmp_path / "absent.npz"))
+
+
+# ----------------------------------------------------------------------
+# heartbeats + hang detection
+# ----------------------------------------------------------------------
+def test_heartbeat_roundtrip(tmp_path):
+    hb = Heartbeat(str(tmp_path), rank=3)
+    assert hb.enabled
+    hb.beat(7)
+    hb.beat(9)
+    assert read_heartbeats(str(tmp_path)) == {3: 9}
+    assert Heartbeat(directory="", rank=0).enabled is False  # no-op mode
+    # torn/alien files are skipped, not fatal
+    (tmp_path / "rank4.hb").write_text("not-a-step")
+    assert read_heartbeats(str(tmp_path)) == {3: 9}
+
+
+# a minimal non-jax elastic worker: stamps heartbeats by hand (pinning
+# the file protocol from the writer side) then follows the scripted
+# behavior for its rank/attempt
+_HB_WORKER = textwrap.dedent("""
+    import os, sys, time
+    rank, mode = sys.argv[1], sys.argv[2]
+    d = os.environ["FF_HEARTBEAT_DIR"]
+    attempt = os.environ["FF_ELASTIC_ATTEMPT"]
+    def beat(step):
+        p = os.path.join(d, "rank%s.hb" % rank)
+        with open(p + ".tmp", "w") as fh:
+            fh.write("%d 0 0\\n" % step)
+        os.replace(p + ".tmp", p)
+    for s in range(3):
+        beat(s)
+        time.sleep(0.05)
+    if mode == "hang" and attempt == "0":
+        time.sleep(120)   # no exit, no progress: only heartbeats see it
+    """)
+
+
+def test_hang_detected_via_heartbeats(tmp_path):
+    """No rank advancing for hang_timeout_s kills the attempt with cause
+    ``hung`` long before attempt_timeout_s, and records per-rank steps."""
+    def argv(attempt, port, rank):
+        return [sys.executable, "-c", _HB_WORKER, str(rank), "hang"]
+
+    t0 = time.monotonic()
+    report = run_elastic(argv, num_processes=2, max_restarts=0,
+                         attempt_timeout_s=60, poll_interval_s=0.1,
+                         hang_timeout_s=1.5, grace_kill_s=2.0)
+    elapsed = time.monotonic() - t0
+    assert not report.success
+    a0 = report.attempts[0]
+    assert a0.cause == "hung", (a0.cause, a0.tails)
+    assert a0.rank_steps == {0: 2, 1: 2}
+    assert elapsed < 30, elapsed  # well under attempt_timeout_s
+
+
+def test_hang_recovers_on_restart(tmp_path):
+    """An attempt-0-only hang is killed early and the restart succeeds."""
+    def argv(attempt, port, rank):
+        return [sys.executable, "-c", _HB_WORKER, str(rank), "hang"]
+
+    report = run_elastic(argv, num_processes=2, max_restarts=1,
+                         attempt_timeout_s=60, poll_interval_s=0.1,
+                         hang_timeout_s=1.5, grace_kill_s=2.0,
+                         backoff_base_s=0.05)
+    assert report.success
+    assert [a.cause for a in report.attempts] == ["hung", "ok"]
+    assert report.attempts[0].backoff_s > 0  # policy slept before retry
+    assert report.restarts == 1
+
+
+def test_straggler_stats_without_hang_detection(tmp_path):
+    """rank_steps are recorded even when hang detection is off."""
+    def argv(attempt, port, rank):
+        return [sys.executable, "-c", _HB_WORKER, str(rank), "ok"]
+
+    report = run_elastic(argv, num_processes=2, max_restarts=0,
+                         attempt_timeout_s=30, poll_interval_s=0.1)
+    assert report.success
+    assert report.attempts[0].rank_steps == {0: 2, 1: 2}
+
+
+# ----------------------------------------------------------------------
+# restart policy: backoff, fail-fast, spawn classification, ports
+# ----------------------------------------------------------------------
+def test_backoff_schedule_deterministic_and_capped():
+    a = backoff_schedule(6, base_s=0.5, max_s=4.0, jitter=0.5, seed=42)
+    b = backoff_schedule(6, base_s=0.5, max_s=4.0, jitter=0.5, seed=42)
+    assert a == b  # seeded jitter: bit-identical schedules
+    assert backoff_schedule(6, 0.5, 4.0, 0.5, 7) != a  # seed decorrelates
+    for i, d in enumerate(a):
+        base = min(4.0, 0.5 * 2 ** i)
+        assert base <= d < base * 1.5  # jitter in [1, 1.5)
+    assert a[-1] < 4.0 * 1.5  # capped at max_s before jitter
+
+
+def test_fail_fast_on_instant_all_rank_crash():
+    """Every rank exiting nonzero essentially instantly on attempt 0 is
+    an argv/config error: supervision stops without burning restarts."""
+    def argv(attempt, port, rank):
+        return [sys.executable, "-c", "import sys; sys.exit(3)"]
+
+    report = run_elastic(argv, num_processes=2, max_restarts=3,
+                         attempt_timeout_s=30, poll_interval_s=0.1,
+                         backoff_base_s=0.05)
+    assert not report.success
+    assert report.fail_fast
+    assert len(report.attempts) == 1  # no restarts burned
+    assert report.attempts[0].cause == "crash"
+
+
+def test_fail_fast_not_triggered_when_a_rank_exits_zero():
+    def argv(attempt, port, rank):
+        return [sys.executable, "-c",
+                "import sys; sys.exit(3 if sys.argv[1] == '0' else 0)",
+                str(rank)]
+
+    report = run_elastic(argv, num_processes=2, max_restarts=1,
+                         attempt_timeout_s=30, poll_interval_s=0.1,
+                         backoff_base_s=0.05)
+    assert not report.success
+    assert not report.fail_fast
+    assert len(report.attempts) == 2  # restarts were attempted
+
+
+def test_spawn_fail_fault_injection():
+    """FF_FAULT spawn_fail_attempt is honored by the SUPERVISOR: the
+    attempt fails before any worker exists, classified ``spawn`` (never
+    counted against fail-fast), and the next attempt proceeds."""
+    def argv(attempt, port, rank):
+        return [sys.executable, "-c", "pass"]
+
+    report = run_elastic(argv, num_processes=2, max_restarts=1,
+                         attempt_timeout_s=30, poll_interval_s=0.1,
+                         backoff_base_s=0.05,
+                         env={"FF_FAULT": "spawn_fail_attempt:0"})
+    assert report.success
+    a0 = report.attempts[0]
+    assert a0.cause == "spawn"
+    assert a0.spawn_error and "spawn_fail_attempt" in a0.spawn_error
+    assert not report.fail_fast
+    assert report.restarts == 1
+
+
+def test_addr_in_use_classified_as_spawn_transient(tmp_path):
+    """A coordinator bind race ("address already in use" in the rank-0
+    tail) is a spawn-class transient: it consumes a restart (with a
+    different port) but is never a fail-fast config error."""
+    worker = textwrap.dedent("""
+        import os, sys
+        if os.environ["FF_ELASTIC_ATTEMPT"] == "0" and sys.argv[1] == "0":
+            print("RuntimeError: Failed to bind to address "
+                  "127.0.0.1:12345: Address already in use")
+            sys.exit(1)
+    """)
+
+    def argv(attempt, port, rank):
+        return [sys.executable, "-c", worker, str(rank)]
+
+    report = run_elastic(argv, num_processes=2, max_restarts=2,
+                         attempt_timeout_s=30, poll_interval_s=0.1,
+                         backoff_base_s=0.05)
+    assert report.success
+    assert not report.fail_fast
+    assert report.attempts[0].cause == "spawn"
+    assert report.attempts[1].cause == "ok"
+    # the retry never reuses the failed attempt's coordinator port
+    assert report.attempts[1].port != report.attempts[0].port
+
+
+def test_free_port_avoids_previous():
+    p1 = free_port()
+    for _ in range(8):  # the avoid set must hold even under immediate reuse
+        assert free_port(avoid=(p1,)) != p1
