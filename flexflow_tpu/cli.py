@@ -32,6 +32,9 @@ def main(argv=None) -> None:
         # supervised multi-process training with restart-from-checkpoint
         # (docs/elastic.md)
         raise SystemExit(elastic_main(argv[1:]))
+    if argv and argv[0] == "lint":
+        # static strategy/graph verifier (docs/verifier.md)
+        raise SystemExit(lint_main(argv[1:]))
     script = None
     for a in argv:
         if a.endswith(".py"):
@@ -42,6 +45,8 @@ def main(argv=None) -> None:
               "       flexflow-tpu elastic [supervisor flags] -- "
               "<script.py> [script args]\n"
               "       flexflow-tpu search-bench [flags]\n"
+              "       flexflow-tpu lint --model NAME [--strategy s.pb] "
+              "[--devices N] [--json]\n"
               "flags (reference model.cc:1221-1289): -e -b --lr --wd -d "
               "--budget --alpha -s/-import -ll:tpu -ll:cpu --nodes "
               "--profiling --seed --remat", file=sys.stderr)
@@ -61,6 +66,110 @@ def main(argv=None) -> None:
     # the script sees the remaining argv like any __main__
     sys.argv = [script] + flags
     runpy.run_path(script, run_name="__main__")
+
+
+def _lint_builders():
+    """Builtin-model registry for ``lint``: name -> zero-config builder
+    returning an FFModel.  Lazy imports keep ``lint --help`` fast."""
+    from .models import (build_alexnet, build_candle_uno, build_dlrm,
+                         build_inception_v3, build_nmt, build_resnet50,
+                         build_transformer)
+    return {
+        "transformer": lambda cfg: build_transformer(cfg)[0],
+        # 8 tables make the default interact width (8*64+64) match
+        # mlp_top[0]=576 (the reference run-script shape)
+        "dlrm": lambda cfg: build_dlrm(
+            cfg, embedding_size=(1000000,) * 8)[0],
+        "alexnet": lambda cfg: build_alexnet(cfg)[0],
+        "resnet": lambda cfg: build_resnet50(cfg)[0],
+        "inception": lambda cfg: build_inception_v3(cfg)[0],
+        "nmt": lambda cfg: build_nmt(cfg)[0],
+        "candle_uno": lambda cfg: build_candle_uno(cfg)[0],
+    }
+
+
+def lint_main(argv) -> int:
+    """``flexflow-tpu lint --model transformer --strategy s.pb``: run the
+    static verifier (flexflow_tpu.analysis) over a builtin model graph +
+    a strategy file and print structured FFxxx diagnostics.  Exit codes:
+    0 clean (INFO/WARN only), 1 any ERROR diagnostic, 2 usage/load
+    failure.  Entirely device-free: a 1024-chip strategy lints on a
+    laptop (no mesh is built, nothing is traced)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="flexflow-tpu lint",
+        description="statically verify a strategy against a builtin "
+                    "model graph (docs/verifier.md)")
+    parser.add_argument("--model", required=True,
+                        help=f"builtin graph: "
+                             f"{', '.join(sorted(_lint_builders()))}")
+    parser.add_argument("--strategy", default="",
+                        help="strategy .pb (reference wire format); "
+                             "omit to lint the graph alone")
+    parser.add_argument("--devices", type=int, default=0,
+                        help="machine size device ids must fit "
+                             "(default: inferred mesh product)")
+    parser.add_argument("--mesh", default="",
+                        help="mesh factorization, e.g. n=4,c=2 "
+                             "(default: inferred from the strategy)")
+    parser.add_argument("-b", "--batch-size", type=int, default=64)
+    parser.add_argument("--hbm-gb", type=float, default=0.0,
+                        help="per-chip HBM budget override in GB "
+                             "(default: attached/assumed device spec)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable report on stdout")
+    parser.add_argument("--no-resharding", action="store_true",
+                        help="skip the FF109 hotspot report")
+    args = parser.parse_args(argv)
+
+    builders = _lint_builders()
+    if args.model not in builders:
+        print(f"lint: unknown model {args.model!r} (have "
+              f"{', '.join(sorted(builders))})", file=sys.stderr)
+        return 2
+    from .config import FFConfig
+    cfg = FFConfig(batch_size=args.batch_size)
+    model = builders[args.model](cfg)
+
+    strategies = None
+    if args.strategy:
+        from .strategy.proto import load_strategy_file
+        try:
+            strategies = load_strategy_file(args.strategy)
+        except (OSError, ValueError) as e:
+            print(f"lint: cannot load {args.strategy}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    mesh_shape = None
+    if args.mesh:
+        try:
+            mesh_shape = {k: int(v) for k, v in
+                          (kv.split("=") for kv in args.mesh.split(","))}
+        except ValueError:
+            print(f"lint: bad --mesh {args.mesh!r} (want n=4,c=2)",
+                  file=sys.stderr)
+            return 2
+
+    spec = None
+    if args.hbm_gb > 0:
+        import dataclasses
+
+        from .search.cost_model import spec_for_device
+        spec = dataclasses.replace(spec_for_device(),
+                                   hbm_capacity=args.hbm_gb * 1e9)
+
+    from .analysis import verify
+    report = verify(
+        model.layers, strategies, mesh_shape=mesh_shape,
+        num_devices=args.devices or None,
+        input_tensors=model.input_tensors,
+        final_tensors=model.layers[-1].outputs if model.layers else (),
+        parameters=model.parameters, spec=spec,
+        check_resharding=not args.no_resharding)
+    print(report.render_json() if args.json else report.render_text())
+    return 1 if report.errors else 0
 
 
 def elastic_main(argv) -> int:

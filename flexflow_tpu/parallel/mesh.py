@@ -96,6 +96,18 @@ def expressible_degrees(size: int) -> Tuple[int, ...]:
     return tuple(sorted(degs))
 
 
+def degree_expressible(axis_size: int, degree: int) -> bool:
+    """THE mesh-expressibility predicate: can ``degree`` shards map onto a
+    sub-axis subset of an axis of ``axis_size``?  This is exactly the
+    decision :meth:`MachineMesh.axis_spec` makes at trace time (same
+    ``subset_for_degree`` core), exported so the static verifier
+    (``flexflow_tpu.analysis``) and the SOAP search judge legality with
+    the GSPMD-reality predicate instead of a reimplementation."""
+    if degree <= 1:
+        return True
+    return subset_for_degree(prime_factors(axis_size), degree) is not None
+
+
 class MachineMesh:
     """A named jax Mesh over the visible devices (or an explicit list).
 

@@ -13,15 +13,19 @@ Mesh-expressibility contract (SURVEY §7 "hard parts"): a config degree for
 logical dim i must be a divisor of the mesh axis size for that dim's
 canonical axis — the mesh factors each axis into prime sub-axes
 (mesh.MachineMesh), so any divisor degree maps to a sub-axis subset; a
-degree that is NOT a realizable divisor falls back to replication with a
-warning instead of crashing the trace (a strategy file from the reference
-may encode placements GSPMD cannot express; running them replicated is the
-honest degrade).
+degree that is NOT a realizable divisor falls back to replication instead
+of crashing the trace (a strategy file from the reference may encode
+placements GSPMD cannot express; running them replicated is the honest
+degrade).  Fallbacks are RECORDED as verifier diagnostics
+(analysis.record_replicate_fallback, aggregated per site — tracing
+revisits a tensor many times) instead of warned per traced tensor; the
+static verifier predicts the same set at compile time from the same
+predicate (analysis.legality.degree_executable), so
+``FFModel.compile(verify="warn")`` surfaces them once, with a count.
 """
 
 from __future__ import annotations
 
-import warnings
 from typing import Optional
 
 from jax.sharding import PartitionSpec
@@ -29,6 +33,14 @@ from jax.sharding import PartitionSpec
 from ..config import ParallelConfig
 from ..tensor import Parameter, Tensor
 from .mesh import MachineMesh, dim_axis_names
+
+
+def _record_fallback(name: str, dim: int, degree: int, axis,
+                     axis_size: int, reason: str) -> None:
+    # lazy import: analysis pulls in the op/cost layers and this module
+    # loads early in the package graph
+    from ..analysis.verifier import record_replicate_fallback
+    record_replicate_fallback(name, dim, degree, axis, axis_size, reason)
 
 
 def output_spec(tensor: Tensor, pc: Optional[ParallelConfig],
@@ -45,19 +57,25 @@ def output_spec(tensor: Tensor, pc: Optional[ParallelConfig],
     dims = pc.dims
     if len(dims) != rank:
         dims = tuple(dims[:rank]) + (1,) * max(0, rank - len(dims))
+    from ..analysis.legality import degree_executable
     entries = []
     for i, (deg, ax) in enumerate(zip(dims, axes)):
-        if deg <= 1 or ax is None or tensor.shape[i] % deg != 0:
+        if deg <= 1:
             entries.append(None)
             continue
-        sub = mesh.axis_spec(ax, deg)
-        if sub is None:
-            warnings.warn(
-                f"{tensor.name}: degree {deg} on dim {i} not expressible on "
-                f"mesh axis {ax!r} (size {mesh.axis_size(ax)}); replicating")
+        size = mesh.axis_size(ax) if ax else 1
+        sub = mesh.axis_spec(ax, deg) if ax else None
+        # the ONE legality predicate (analysis.legality), shared with the
+        # SOAP search and the static verifier; the mesh's own axis_spec
+        # answer is passed in so expressibility is decided (and searched)
+        # exactly once per dim
+        reason = degree_executable(tensor.shape[i], deg, size, ax,
+                                   expressible=sub is not None)
+        if reason is not None:
+            _record_fallback(tensor.name, i, deg, ax, size, reason)
             entries.append(None)
             continue
-        entries.append(ax if deg == mesh.axis_size(ax) else sub)
+        entries.append(ax if deg == size else sub)
     return PartitionSpec(*entries)
 
 
@@ -98,13 +116,16 @@ def param_spec(param: Parameter, pc: Optional[ParallelConfig],
     for deg, ax in zip(pc.dims, axes):
         if ax == "c":
             c_deg = deg
-    if c_deg <= 1 or param.shape[param.sharded_dim] % c_deg != 0:
+    if c_deg <= 1:
         return PartitionSpec()
+    from ..analysis.legality import degree_executable
     sub = mesh.axis_spec("c", c_deg)
-    if sub is None:
-        warnings.warn(f"{param.name}: channel degree {c_deg} not expressible "
-                      f"on mesh c axis (size {mesh.axis_size('c')}); "
-                      f"replicating")
+    reason = degree_executable(param.shape[param.sharded_dim], c_deg,
+                               mesh.axis_size("c"), "c",
+                               expressible=sub is not None)
+    if reason is not None:
+        _record_fallback(param.name, param.sharded_dim, c_deg, "c",
+                         mesh.axis_size("c"), reason)
         return PartitionSpec()
     entries = [None] * len(param.shape)
     entries[param.sharded_dim] = ("c" if c_deg == mesh.axis_size("c")

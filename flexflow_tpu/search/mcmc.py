@@ -25,9 +25,10 @@ import random
 import warnings
 from typing import Dict, List, Optional, Tuple
 
+from ..analysis.legality import per_dim_degrees as _per_dim_degrees
 from ..config import FFConfig, ParallelConfig
 from ..op import Op
-from ..parallel.mesh import AXES, dim_axis_names, expressible_degrees
+from ..parallel.mesh import AXES, expressible_degrees
 from .cost_model import DEFAULT_SPEC, DeviceSpec, spec_for_device
 from .simulator import Simulator
 
@@ -73,29 +74,11 @@ def _prod(xs) -> int:
     return n
 
 
-def _per_dim_degrees(op: Op, mesh_shape: MeshShape
-                     ) -> List[Tuple[int, ...]]:
-    """THE per-op legality definition, shared by the full enumeration
-    (legal_configs) and the aligned seed (aligned_for_mesh): for each
-    output dim, the degrees that are divisors of its canonical axis size
-    (all divisors are sub-axis-expressible), divide the dim extent, and
-    are allowed by the op (reference Op::get_random_parallel_config,
-    model.cc:276-305)."""
-    out_t = op.outputs[0]
-    nd = out_t.num_dims
-    allowed = op.parallel_dims()
-    axes = dim_axis_names(nd)
-    per_dim: List[Tuple[int, ...]] = []
-    for i in range(nd):
-        ax = axes[i] if i < len(axes) else None
-        if (ax is None or i >= len(allowed) or not allowed[i]
-                or mesh_shape.get(ax, 1) <= 1):
-            per_dim.append((1,))
-            continue
-        degs = tuple(d for d in expressible_degrees(mesh_shape[ax])
-                     if out_t.shape[i] % d == 0)
-        per_dim.append(degs or (1,))
-    return per_dim
+# THE per-op legality definition now lives in analysis.legality
+# (per_dim_degrees): one predicate module shared by this search, the
+# trace-time sharding fallbacks and the static verifier, so the simulator
+# can never cost a split the executor silently replicates
+# (tests/test_verifier.py cross-checks every proposal).
 
 
 def legal_configs(op: Op, mesh_shape: MeshShape,

@@ -30,9 +30,25 @@ _WIRE_VARINT = 0
 _WIRE_LEN = 2
 
 
-def _read_varint(buf: memoryview, pos: int) -> Tuple[int, int]:
+class StrategyParseError(ValueError):
+    """Malformed/truncated strategy file.  Always carries the absolute
+    file offset and the field being parsed — a truncated ``.pb`` must
+    fail with WHERE, not an ``IndexError`` from varint internals."""
+
+
+def _fail(base: int, pos: int, field: str, what: str) -> None:
+    raise StrategyParseError(
+        f"strategy file byte {base + pos}: {what} while reading {field}")
+
+
+def _read_varint(buf: memoryview, pos: int, base: int = 0,
+                 field: str = "varint") -> Tuple[int, int]:
     result = shift = 0
     while True:
+        if pos >= len(buf):
+            _fail(base, pos, field, "truncated varint")
+        if shift > 63:
+            _fail(base, pos, field, "varint longer than 64 bits")
         b = buf[pos]
         pos += 1
         result |= (b & 0x7F) << shift
@@ -52,23 +68,37 @@ def _write_varint(out: io.BytesIO, value: int) -> None:
             return
 
 
+def _checked_len(buf: memoryview, pos: int, base: int,
+                 field: str) -> Tuple[int, int]:
+    """Length prefix + bounds check: the declared span must lie inside
+    the buffer."""
+    ln, pos = _read_varint(buf, pos, base, field + " length")
+    if pos + ln > len(buf):
+        _fail(base, pos, field,
+              f"declared length {ln} overruns the remaining "
+              f"{len(buf) - pos} bytes")
+    return ln, pos
+
+
 def _parse_repeated_int32(buf: memoryview, pos: int, wire: int,
-                          dest: List[int]) -> int:
+                          dest: List[int], base: int, field: str) -> int:
     if wire == _WIRE_VARINT:
-        v, pos = _read_varint(buf, pos)
+        v, pos = _read_varint(buf, pos, base, field)
         dest.append(v)
     elif wire == _WIRE_LEN:  # packed
-        ln, pos = _read_varint(buf, pos)
+        ln, pos = _checked_len(buf, pos, base, field + " (packed)")
         end = pos + ln
         while pos < end:
-            v, pos = _read_varint(buf, pos)
+            v, pos = _read_varint(buf, pos, base, field + " (packed)")
             dest.append(v)
     else:
-        raise ValueError(f"bad wire type {wire} for repeated int32")
+        _fail(base, pos, field, f"bad wire type {wire} for repeated int32")
     return pos
 
 
-def _parse_op(data: bytes) -> Tuple[str, ParallelConfig]:
+def _parse_op(data: bytes, base: int = 0) -> Tuple[str, ParallelConfig]:
+    """Parse one Op message.  ``base`` is the message's absolute offset in
+    the file, so every parse error names the real file position."""
     buf = memoryview(data)
     pos = 0
     name = ""
@@ -77,35 +107,51 @@ def _parse_op(data: bytes) -> Tuple[str, ParallelConfig]:
     device_ids: List[int] = []
     memory_types: List[int] = []
     while pos < len(buf):
-        tag, pos = _read_varint(buf, pos)
+        tag, pos = _read_varint(buf, pos, base, "Op tag")
         field, wire = tag >> 3, tag & 7
         if field == 1:
-            ln, pos = _read_varint(buf, pos)
-            name = bytes(buf[pos:pos + ln]).decode("utf-8")
+            ln, pos = _checked_len(buf, pos, base, "Op.name")
+            try:
+                name = bytes(buf[pos:pos + ln]).decode("utf-8")
+            except UnicodeDecodeError as e:
+                # e.start is relative to the sliced name bytes; report
+                # the absolute file offset like every other parse error
+                raise StrategyParseError(
+                    f"strategy file byte {base + pos + e.start}: invalid "
+                    f"UTF-8 while reading Op.name") from e
             pos += ln
         elif field == 2:
-            device_type, pos = _read_varint(buf, pos)
+            device_type, pos = _read_varint(buf, pos, base,
+                                            "Op.device_type")
         elif field == 3:
-            pos = _parse_repeated_int32(buf, pos, wire, dims)
+            pos = _parse_repeated_int32(buf, pos, wire, dims, base,
+                                        "Op.dims")
         elif field == 4:
-            pos = _parse_repeated_int32(buf, pos, wire, device_ids)
+            pos = _parse_repeated_int32(buf, pos, wire, device_ids, base,
+                                        "Op.device_ids")
         elif field == 5:
-            pos = _parse_repeated_int32(buf, pos, wire, memory_types)
+            pos = _parse_repeated_int32(buf, pos, wire, memory_types, base,
+                                        "Op.memory_types")
         else:  # skip unknown
+            fld = f"unknown field {field}"
             if wire == _WIRE_VARINT:
-                _, pos = _read_varint(buf, pos)
+                _, pos = _read_varint(buf, pos, base, fld)
             elif wire == _WIRE_LEN:
-                ln, pos = _read_varint(buf, pos)
+                ln, pos = _checked_len(buf, pos, base, fld)
                 pos += ln
             else:
-                raise ValueError(f"unknown wire type {wire}")
-    pc = ParallelConfig(
-        device_type=DeviceType(device_type),
-        dims=tuple(reversed(dims)),  # file is innermost-first
-        device_ids=tuple(device_ids) or tuple(
-            range(max(1, _prod(dims)))),
-        memory_types=tuple(MemoryType(m) for m in memory_types),
-    )
+                _fail(base, pos, fld, f"unknown wire type {wire}")
+    try:
+        pc = ParallelConfig(
+            device_type=DeviceType(device_type),
+            dims=tuple(reversed(dims)),  # file is innermost-first
+            device_ids=tuple(device_ids) or tuple(
+                range(max(1, _prod(dims)))),
+            memory_types=tuple(MemoryType(m) for m in memory_types),
+        )
+    except ValueError as e:  # bad enum value: say which op, keep offset
+        raise StrategyParseError(
+            f"strategy file byte {base}: op {name!r}: {e}") from e
     return name, pc
 
 
@@ -117,19 +163,30 @@ def _prod(xs) -> int:
 
 
 def loads(data: bytes) -> Dict[str, ParallelConfig]:
+    """Parse a Strategy message.  Malformed/truncated input raises
+    :class:`StrategyParseError` (a ValueError) naming the absolute byte
+    offset and field; duplicate op names are rejected — silently keeping
+    the LAST entry (the old dict-overwrite behavior) would let a
+    hand-edited file drop a strategy without a trace."""
     buf = memoryview(data)
     pos = 0
     out: Dict[str, ParallelConfig] = {}
     while pos < len(buf):
-        tag, pos = _read_varint(buf, pos)
+        tag, pos = _read_varint(buf, pos, 0, "Strategy tag")
         field, wire = tag >> 3, tag & 7
         if field == 1 and wire == _WIRE_LEN:
-            ln, pos = _read_varint(buf, pos)
-            name, pc = _parse_op(bytes(buf[pos:pos + ln]))
+            ln, pos = _checked_len(buf, pos, 0, "Strategy.ops entry")
+            name, pc = _parse_op(bytes(buf[pos:pos + ln]), base=pos)
+            if name in out:
+                raise StrategyParseError(
+                    f"strategy file byte {pos}: duplicate op name "
+                    f"{name!r} (an earlier entry would be silently "
+                    f"overwritten)")
             pos += ln
             out[name] = pc
         else:
-            raise ValueError(f"unexpected top-level field {field}/{wire}")
+            _fail(0, pos, "Strategy",
+                  f"unexpected top-level field {field}/{wire}")
     return out
 
 

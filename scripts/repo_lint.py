@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Custom AST lint enforcing repo invariants ruff cannot express.
+
+Run by ``scripts/static_checks.sh`` (the repo static gate, also smoke-run
+by tier-1 ``tests/test_static_checks.py``).  Rules:
+
+* **RL001 — checkpoint writes go through ``resilience._atomic_savez``**:
+  a bare ``np.savez``/``savez_compressed`` in ``flexflow_tpu/`` can leave
+  a truncated file at the final name on a crash, which costs every
+  elastic restart a verification-and-fallback pass (PR 2's atomic-publish
+  contract).  Only ``flexflow_tpu/resilience.py`` may call it.
+* **RL002 — no ``warnings.warn`` in strategy/sharding paths**: legality
+  findings in ``flexflow_tpu/strategy/`` and
+  ``flexflow_tpu/parallel/sharding.py`` must be structured diagnostics
+  (``flexflow_tpu.analysis``) — per-trace warnings are unaggregated,
+  unmachine-readable, and exactly the scattered-legality failure ISSUE 3
+  unified away.
+* **RL003 — no unseeded RNG in tests**: module-level ``random.*`` /
+  ``np.random.*`` draws make failures irreproducible; tests must use
+  ``np.random.default_rng(seed)`` / ``random.Random(seed)`` /
+  ``jax.random.PRNGKey(seed)``.
+
+Exit 0 when clean, 1 with ``file:line: RLxxx message`` findings on
+stdout.  No third-party deps — must run on a bare CPython.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# np.random module-level constructors/utilities that are NOT draws
+_NP_RANDOM_OK = {"default_rng", "RandomState", "Generator", "seed",
+                 "get_state", "set_state", "SeedSequence", "PCG64",
+                 "Philox", "MT19937", "BitGenerator"}
+# stdlib random module members that are not global-state draws
+_PY_RANDOM_OK = {"Random", "SystemRandom", "seed", "getstate", "setstate"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'np.random.randn' for Attribute chains rooted at a Name."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _rel(path: str) -> str:
+    return os.path.relpath(path, REPO).replace(os.sep, "/")
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, relpath: str):
+        self.relpath = relpath
+        self.findings: List[Tuple[int, str, str]] = []
+        self.in_library = relpath.startswith("flexflow_tpu/")
+        self.is_resilience = relpath == "flexflow_tpu/resilience.py"
+        self.in_diag_scope = (
+            relpath.startswith("flexflow_tpu/strategy/")
+            or relpath == "flexflow_tpu/parallel/sharding.py")
+        self.in_tests = relpath.startswith("tests/")
+
+    def _add(self, node: ast.AST, code: str, msg: str) -> None:
+        self.findings.append((node.lineno, code, msg))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _dotted(node.func)
+        if name:
+            self._check_savez(node, name)
+            self._check_warn(node, name)
+            self._check_rng(node, name)
+        self.generic_visit(node)
+
+    def _check_savez(self, node: ast.Call, name: str) -> None:
+        if not self.in_library or self.is_resilience:
+            return
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf in ("savez", "savez_compressed"):
+            self._add(node, "RL001",
+                      f"direct {name}() — checkpoint writes must go "
+                      f"through resilience._atomic_savez (atomic "
+                      f"tmp+rename publish)")
+
+    def _check_warn(self, node: ast.Call, name: str) -> None:
+        if self.in_diag_scope and name == "warnings.warn":
+            self._add(node, "RL002",
+                      "warnings.warn in a strategy/sharding path — emit "
+                      "a structured diagnostic via flexflow_tpu.analysis "
+                      "instead")
+
+    def _check_rng(self, node: ast.Call, name: str) -> None:
+        if not self.in_tests:
+            return
+        parts = name.split(".")
+        if parts[:2] in (["np", "random"], ["numpy", "random"]) \
+                and len(parts) == 3 and parts[2] not in _NP_RANDOM_OK:
+            self._add(node, "RL003",
+                      f"unseeded global-state {name}() in a test — use "
+                      f"np.random.default_rng(seed)")
+        elif parts[0] == "random" and len(parts) == 2 \
+                and parts[1] not in _PY_RANDOM_OK:
+            self._add(node, "RL003",
+                      f"unseeded global-state {name}() in a test — use "
+                      f"random.Random(seed)")
+
+
+def lint_file(path: str) -> List[str]:
+    rel = _rel(path)
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [f"{rel}:{e.lineno or 0}: RL000 syntax error: {e.msg}"]
+    v = _Visitor(rel)
+    v.visit(tree)
+    return [f"{rel}:{ln}: {code} {msg}"
+            for ln, code, msg in sorted(v.findings)]
+
+
+def iter_py(roots: List[str]) -> List[str]:
+    out = []
+    for root in roots:
+        if os.path.isfile(root):
+            out.append(root)
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            out.extend(os.path.join(dirpath, f)
+                       for f in filenames if f.endswith(".py"))
+    return sorted(out)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    roots = argv or [os.path.join(REPO, "flexflow_tpu"),
+                     os.path.join(REPO, "tests"),
+                     os.path.join(REPO, "scripts")]
+    findings: List[str] = []
+    for path in iter_py(roots):
+        findings.extend(lint_file(path))
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"repo_lint: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

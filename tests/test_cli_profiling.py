@@ -86,12 +86,30 @@ def test_profiling_prints_per_op_table(capsys):
     assert "fwd(ms)" in out and "conv2d" in out and "dense" in out
 
 
-def test_noncanonical_device_ids_warn():
+def test_noncanonical_device_ids_diagnosed():
+    """Explicit device ids outside the machine surface through the
+    verifier (FF104, aggregate compile warning) — the structured
+    replacement for the old ad-hoc device_ids warning."""
     cfg = ff.FFConfig(batch_size=8, compute_dtype="float32")
     cfg.strategies = {"dense": ParallelConfig(dims=(1, 1), device_ids=(3,))}
     model = ff.FFModel(cfg)
     x = model.create_tensor((8, 4), name="x")
     t = model.dense(x, 4)
-    with pytest.warns(UserWarning, match="device_ids"):
+    with pytest.warns(UserWarning, match="device ids"):
         model.compile(ff.SGDOptimizer(lr=0.1),
                       "sparse_categorical_crossentropy", [], final_tensor=t)
+    assert "FF104" in model.verify_report.codes()
+    # in-range but non-canonical ids: INFO-level FF111, no warning
+    cfg2 = ff.FFConfig(batch_size=8, compute_dtype="float32")
+    cfg2.strategies = {
+        "dense": ParallelConfig(dims=(2, 1), device_ids=(1, 0))}
+    model2 = ff.FFModel(cfg2)
+    x2 = model2.create_tensor((8, 4), name="x")
+    t2 = model2.dense(x2, 4)
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        model2.compile(ff.SGDOptimizer(lr=0.1),
+                       "sparse_categorical_crossentropy", [],
+                       final_tensor=t2)
+    assert "FF111" in model2.verify_report.codes()

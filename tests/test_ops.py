@@ -16,6 +16,9 @@ from flexflow_tpu.ops.tensor_ops import (Concat, Dropout, Flat, Softmax,
 from flexflow_tpu.tensor import Tensor
 
 
+_rng = np.random.default_rng(0)  # seeded: repo lint RL003
+
+
 def ctx32(**kw):
     return OpContext(compute_dtype="float32",
                      rng=jax.random.PRNGKey(0), **kw)
@@ -35,7 +38,7 @@ def test_linear_matches_numpy():
     t = Tensor((4, 8), name="x")
     op = Linear("fc", t, 16, activation=None)
     params = init_params(op)
-    x = np.random.randn(4, 8).astype(np.float32)
+    x = _rng.standard_normal((4, 8)).astype(np.float32)
     y = op.forward(params, [jnp.asarray(x)], ctx32())[0]
     ref = x @ np.asarray(params[op.w_kernel.name]).T + \
         np.asarray(params[op.w_bias.name])
@@ -56,7 +59,7 @@ def test_conv2d_shape_and_value():
     op = Conv2D("conv", t, 4, 3, 3, 1, 1, 1, 1)
     assert op.outputs[0].shape == (2, 4, 8, 8)
     params = init_params(op)
-    x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+    x = _rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
     y = np.asarray(op.forward(params, [jnp.asarray(x)], ctx32())[0])
     # check one output element against a naive dot product
     k = np.asarray(params[op.w_kernel.name])
@@ -97,7 +100,7 @@ def test_softmax_rows_sum_to_one():
     t = Tensor((3, 7))
     op = Softmax("sm", t)
     y = np.asarray(op.forward({}, [jnp.asarray(
-        np.random.randn(3, 7).astype(np.float32))], ctx32())[0])
+        _rng.standard_normal((3, 7)).astype(np.float32))], ctx32())[0])
     np.testing.assert_allclose(y.sum(-1), np.ones(3), rtol=1e-5)
 
 
@@ -105,8 +108,8 @@ def test_concat_split_roundtrip():
     a, b = Tensor((2, 3)), Tensor((2, 5))
     cat = Concat("cat", [a, b], axis=1)
     assert cat.outputs[0].shape == (2, 8)
-    xa = jnp.asarray(np.random.randn(2, 3).astype(np.float32))
-    xb = jnp.asarray(np.random.randn(2, 5).astype(np.float32))
+    xa = jnp.asarray(_rng.standard_normal((2, 3)).astype(np.float32))
+    xb = jnp.asarray(_rng.standard_normal((2, 5)).astype(np.float32))
     y = cat.forward({}, [xa, xb], ctx32())[0]
     sp = Split("sp", cat.outputs[0], [3, 5], axis=1)
     ya, yb = sp.forward({}, [y], ctx32())
@@ -116,7 +119,7 @@ def test_concat_split_roundtrip():
 
 def test_element_ops():
     t = Tensor((2, 3))
-    x = jnp.asarray(np.random.randn(2, 3).astype(np.float32))
+    x = jnp.asarray(_rng.standard_normal((2, 3)).astype(np.float32))
     relu = ElementUnary("r", t, "relu")
     assert np.all(np.asarray(relu.forward({}, [x], ctx32())[0]) >= 0)
     add = ElementBinary("a", t, Tensor((2, 3)), "add")
@@ -140,7 +143,8 @@ def test_batchnorm_normalizes():
     t = Tensor((8, 4, 2, 2))
     op = BatchNorm("bn", t, relu=False)
     params = init_params(op)
-    x = jnp.asarray(np.random.randn(8, 4, 2, 2).astype(np.float32) * 3 + 1)
+    x = jnp.asarray(
+        _rng.standard_normal((8, 4, 2, 2)).astype(np.float32) * 3 + 1)
     ctx = ctx32(training=True)
     y = np.asarray(op.forward(params, [x], ctx)[0])
     assert abs(y.mean()) < 1e-4
@@ -162,7 +166,7 @@ def test_layernorm_rmsnorm():
     t = Tensor((2, 5, 8))
     ln = LayerNorm("ln", t)
     rn = RMSNorm("rn", t)
-    x = jnp.asarray(np.random.randn(2, 5, 8).astype(np.float32))
+    x = jnp.asarray(_rng.standard_normal((2, 5, 8)).astype(np.float32))
     yl = np.asarray(ln.forward(init_params(ln), [x], ctx32())[0])
     np.testing.assert_allclose(yl.mean(-1), np.zeros((2, 5)), atol=1e-5)
     yr = np.asarray(rn.forward(init_params(rn), [x], ctx32())[0])

@@ -141,13 +141,21 @@ def test_output_spec_mesh_expressibility():
     # mixed degree < axis size maps onto a prime sub-axis subset
     spec = output_spec(t, ParallelConfig(dims=(2, 2),
                                          device_ids=tuple(range(4))), mesh)
-    assert tuple(spec) == ("n0", "c")  # sub-axis subset of the n axis
-    # a non-divisor degree degrades to replication with a warning
-    t3 = Tensor((30, 64))
-    with pytest.warns(UserWarning):
-        spec = output_spec(t3, ParallelConfig(dims=(3, 1),
-                                              device_ids=(0, 1, 2)), mesh)
+    assert tuple(spec) == (("n0",), "c")  # sub-axis subset of the n axis
+    # a non-divisor degree degrades to replication, RECORDED as an
+    # aggregated verifier diagnostic (FF106) instead of one warning per
+    # traced tensor (ISSUE 3)
+    from flexflow_tpu.analysis import drain_replicate_fallbacks
+    drain_replicate_fallbacks()  # clear prior traces
+    t3 = Tensor((30, 64), name="t3")
+    spec = output_spec(t3, ParallelConfig(dims=(3, 1),
+                                          device_ids=(0, 1, 2)), mesh)
     assert tuple(spec) == (None, None)
+    diags = drain_replicate_fallbacks()
+    assert [d.code for d in diags] == ["FF106"]
+    assert "degree 3" in diags[0].message
+    assert diags[0].op == "t3"
+    assert drain_replicate_fallbacks() == []  # drained
 
 
 def test_mixed_degree_strategy_executes():

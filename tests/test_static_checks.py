@@ -1,0 +1,146 @@
+"""Tier-1 smoke tests for the repo static gate (ISSUE 3): the
+``flexflow-tpu lint`` CLI detects every seeded defect class with its
+exact FFxxx code and nonzero exit, ``scripts/static_checks.sh`` runs
+clean on the repo, and ``scripts/repo_lint.py`` enforces its RLxxx
+invariants on synthetic violations."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tests.subproc import REPO, cached_env
+
+LINT = [sys.executable, "-m", "flexflow_tpu.cli", "lint"]
+
+
+def _write_bad_strategy(path):
+    from flexflow_tpu.config import ParallelConfig
+    from flexflow_tpu.strategy.proto import save_strategy_file
+
+    # transformer defaults: batch 64, seq 128, d_model 512, rank-3 outs
+    save_strategy_file(path, {
+        # FF101: 3 does not divide batch 64
+        "ffn_up_0": ParallelConfig(dims=(3, 1, 1), device_ids=(0, 1, 2)),
+        # FF102 (ERROR): 4 degrees on a rank-3 output, real tail degree
+        "ffn_down_0": ParallelConfig(dims=(1, 1, 1, 2),
+                                     device_ids=(0,)),
+        # FF103: 2 ids for 4 parts
+        "ln_attn_0": ParallelConfig(dims=(2, 2, 1), device_ids=(0, 1)),
+        # FF104: id 99 on a 12-device machine
+        "attention_0": ParallelConfig(dims=(2, 1, 1),
+                                      device_ids=(0, 99)),
+        # FF105: degree 4 divides batch 64 but not the n=6 axis
+        "ffn_down_1": ParallelConfig(dims=(4, 1, 1),
+                                     device_ids=(0, 1, 2, 3)),
+        # duplicate-name case is covered at the proto layer
+        # (tests/test_strategy_proto_roundtrip.py): loads() rejects it
+    })
+
+
+def test_lint_cli_detects_seeded_defects_with_exact_codes(tmp_path):
+    bad = str(tmp_path / "bad.pb")
+    _write_bad_strategy(bad)
+    r = subprocess.run(
+        LINT + ["--model", "transformer", "--strategy", bad,
+                "--mesh", "n=6,c=2", "--devices", "12",
+                "--no-resharding"],
+        capture_output=True, text=True, env=cached_env(), cwd=REPO,
+        timeout=300)
+    assert r.returncode == 1, r.stderr  # ERROR diagnostics -> exit 1
+    out = r.stdout
+    for code in ("FF101", "FF102", "FF103", "FF104", "FF105"):
+        assert code in out, f"{code} missing from:\n{out}"
+    assert "ERROR" in out and "summary:" in out
+
+
+def test_lint_cli_memory_budget_and_clean_exit(tmp_path):
+    from flexflow_tpu.config import ParallelConfig
+    from flexflow_tpu.strategy.proto import save_strategy_file
+
+    ok = str(tmp_path / "ok.pb")
+    save_strategy_file(ok, {"ffn_up_0": ParallelConfig(
+        dims=(2, 1, 1), device_ids=(0, 1))})
+    # FF108: the default transformer cannot fit a 0.001 GB chip
+    r = subprocess.run(
+        LINT + ["--model", "transformer", "--strategy", ok,
+                "--hbm-gb", "0.001", "--no-resharding"],
+        capture_output=True, text=True, env=cached_env(), cwd=REPO,
+        timeout=300)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "FF108" in r.stdout
+    # same strategy, real budget: clean -> exit 0
+    r = subprocess.run(
+        LINT + ["--model", "transformer", "--strategy", ok,
+                "--no-resharding"],
+        capture_output=True, text=True, env=cached_env(), cwd=REPO,
+        timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    # malformed file -> usage/load failure exit 2, offset in message
+    broken = str(tmp_path / "broken.pb")
+    with open(broken, "wb") as f:
+        f.write(b"\x0a\x63trunc")
+    r = subprocess.run(
+        LINT + ["--model", "transformer", "--strategy", broken],
+        capture_output=True, text=True, env=cached_env(), cwd=REPO,
+        timeout=300)
+    assert r.returncode == 2
+    assert "byte" in r.stderr
+
+
+def test_static_checks_script_passes_on_repo():
+    r = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "static_checks.sh")],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "static checks: OK" in r.stdout
+
+
+@pytest.mark.parametrize("rel,src,code", [
+    ("flexflow_tpu/zz_bad_ckpt.py",
+     "import numpy as np\n\ndef f(path, d):\n    np.savez(path, **d)\n",
+     "RL001"),
+    ("flexflow_tpu/strategy/zz_bad_warn.py",
+     "import warnings\n\ndef f():\n    warnings.warn('x')\n",
+     "RL002"),
+    ("flexflow_tpu/parallel/sharding_zz.py",  # not the scoped file
+     "import warnings\n\ndef f():\n    warnings.warn('x')\n",
+     None),
+    ("tests/zz_bad_rng.py",
+     "import numpy as np\nx = np.random.randn(3)\n",
+     "RL003"),
+    ("tests/zz_ok_rng.py",
+     "import numpy as np\nr = np.random.default_rng(0)\n"
+     "x = r.standard_normal(3)\n",
+     None),
+])
+def test_repo_lint_rules(tmp_path, rel, src, code):
+    """repo_lint unit check on synthetic files, laid out under tmp_path
+    mirroring the repo so the path-scoped rules engage."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import repo_lint
+    finally:
+        sys.path.pop(0)
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(src)
+    # patch the repo root so _rel() yields the mirrored relative path
+    old = repo_lint.REPO
+    repo_lint.REPO = str(tmp_path)
+    try:
+        findings = repo_lint.lint_file(str(path))
+    finally:
+        repo_lint.REPO = old
+    if code is None:
+        assert findings == [], findings
+    else:
+        assert findings and code in findings[0], findings
+
+
+def test_repo_lint_clean_on_this_repo():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "repo_lint.py")],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
