@@ -92,6 +92,12 @@ HBM_BW = {
 # users without the measured win).
 CONV_LAYOUT = "auto"
 
+# --steps-per-dispatch K (env FF_BENCH_K): fuse K train steps into one
+# dispatched lax.scan window (FFConfig.steps_per_dispatch) so the sweep
+# can record dispatch-amortized rows alongside the K=1 baseline — the
+# microbenchmark isolating the effect is `flexflow-tpu train-bench`.
+STEPS_PER_DISPATCH = max(1, int(os.environ.get("FF_BENCH_K", "1")))
+
 # --flash auto|on|off -> config.flash_attention None/True/False.  The
 # round-3 tuning that set auto's s>=1024 threshold timed FORWARD only;
 # in training the dense path also pays the O(s^2) score matrix in the
@@ -115,6 +121,7 @@ def build(model_name: str, batch_size: int):
     cfg = ff.FFConfig(batch_size=batch_size, compute_dtype="bfloat16")
     cfg.conv_layout = CONV_LAYOUT  # "auto" resolves in the library
     cfg.flash_attention = {"auto": None, "on": True, "off": False}[FLASH]
+    cfg.steps_per_dispatch = STEPS_PER_DISPATCH
     if model_name == "inception_v3":
         from flexflow_tpu.models.inception import build_inception_v3
         model, inp, logits = build_inception_v3(cfg, num_classes=1000,
@@ -360,18 +367,36 @@ def bench_model(model_name, batch_size, iters):
     batch = model._shard_batch(tuple(xs) + (y,))
     jax.block_until_ready(batch)
 
+    # --steps-per-dispatch K: each timed call dispatches ONE fused K-step
+    # window over the same device-resident batch stacked K times (the
+    # dispatch-amortized path fit() runs at steps_per_dispatch=K); the
+    # samples/s denominator scales by K below via steps_per_call
+    k = STEPS_PER_DISPATCH
+    steps_per_call = k
+    if k > 1:
+        import jax.numpy as jnp
+        window = tuple(jnp.stack([a] * k) for a in batch)
+        jax.block_until_ready(window)
+
+        def one_call():
+            losses, _ = model.train_window(window)
+            return losses[-1]
+    else:
+        def one_call():
+            return model.train_batch(*batch)
+
     # warmup / compile; fetch the loss to force completion (the only real
     # execution fence on tunneled PJRT backends — block_until_ready
     # returns at dispatch there)
     for _ in range(3):
-        loss = model.train_batch(*batch)
+        loss = one_call()
     float(loss)
 
     def run(n):
         t0 = time.perf_counter()
         loss = None
         for _ in range(n):
-            loss = model.train_batch(*batch)
+            loss = one_call()
         val = float(loss)  # host fetch fences the whole chained queue
         return time.perf_counter() - t0, val
 
@@ -387,14 +412,14 @@ def bench_model(model_name, batch_size, iters):
         dt = min(t3a, t3b) / 3
     assert np.isfinite(final_loss), final_loss
 
-    sps = batch_size * iters / dt
+    sps = batch_size * iters * steps_per_call / dt
     per_chip = sps / max(1, n_chips)
     base = A100_SAMPLES_PER_SEC.get(model_name)
     # fwd FLOPs from the op-level analytic model; training step ~= 3x fwd
     # (bwd-data + bwd-filter each ~1x fwd for conv/matmul ops)
     fwd_flops = sum(op.flops() for op in model.layers)
     step_flops = 3 * fwd_flops
-    achieved = step_flops * iters / dt / max(1, n_chips)
+    achieved = step_flops * iters * steps_per_call / dt / max(1, n_chips)
     kind = jax.devices()[0].device_kind
     peak = PEAK_FLOPS.get(kind)
     row = {
@@ -402,7 +427,8 @@ def bench_model(model_name, batch_size, iters):
         "value": round(per_chip, 2),
         "unit": "samples/s/chip",
         "vs_baseline": round(per_chip / base, 4) if base else None,
-        "ms_per_step": round(dt / iters * 1e3, 2),
+        "ms_per_step": round(dt / (iters * steps_per_call) * 1e3, 2),
+        "steps_per_dispatch": k,
         "tflops_per_chip": round(achieved / 1e12, 2),
         "mfu": round(achieved / peak, 4) if peak else None,
         "batch_size": batch_size,
@@ -419,7 +445,7 @@ def bench_model(model_name, batch_size, iters):
 
 
 def main():
-    global CONV_LAYOUT, FLASH
+    global CONV_LAYOUT, FLASH, STEPS_PER_DISPATCH
     model_name = None  # default: full sweep
     batch_size = 0
     iters = 20
@@ -452,6 +478,8 @@ def main():
             if FLASH not in ("auto", "on", "off"):
                 _error_line(f"--flash must be auto|on|off, got {FLASH!r}")
                 raise SystemExit(2)
+        if a == "--steps-per-dispatch":
+            STEPS_PER_DISPATCH = max(1, int(_val(i, a)))
     if "--all" in args or model_name == "all":
         model_name = None
 
@@ -487,7 +515,8 @@ def _subprocess_bench(budget_s):
     def f(name, batch_size, iters):
         cmd = [sys.executable, os.path.abspath(__file__),
                "--model", name, "--iters", str(iters),
-               "--conv-layout", CONV_LAYOUT, "--flash", FLASH]
+               "--conv-layout", CONV_LAYOUT, "--flash", FLASH,
+               "--steps-per-dispatch", str(STEPS_PER_DISPATCH)]
         if batch_size:
             cmd += ["--batch", str(batch_size)]
         # floor 300s > the child's worst-case probe (2 x 60s + 30s

@@ -28,6 +28,12 @@ def main(argv=None) -> None:
         from .search.bench import main as bench_main
         bench_main(argv[1:])
         return
+    if argv and argv[0] == "train-bench":
+        # dispatch-amortization microbenchmark: fit() steps/s across
+        # steps_per_dispatch values (JSON to stdout; docs/performance.md)
+        from .train_bench import main as train_bench_main
+        train_bench_main(argv[1:])
+        return
     if argv and argv[0] == "elastic":
         # supervised multi-process training with restart-from-checkpoint
         # (docs/elastic.md)
@@ -45,11 +51,13 @@ def main(argv=None) -> None:
               "       flexflow-tpu elastic [supervisor flags] -- "
               "<script.py> [script args]\n"
               "       flexflow-tpu search-bench [flags]\n"
+              "       flexflow-tpu train-bench [flags]\n"
               "       flexflow-tpu lint --model NAME [--strategy s.pb] "
               "[--devices N] [--json]\n"
               "flags (reference model.cc:1221-1289): -e -b --lr --wd -d "
               "--budget --alpha -s/-import -ll:tpu -ll:cpu --nodes "
-              "--profiling --seed --remat", file=sys.stderr)
+              "--profiling --seed --remat --steps-per-dispatch --pad-tail",
+              file=sys.stderr)
         raise SystemExit(2)
     flags = [a for a in argv if a != script]
     cfg = FFConfig.parse_args(flags)

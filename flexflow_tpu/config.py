@@ -160,6 +160,34 @@ class FFConfig:
     # microbatch's measurement once per step.  batch_size must divide
     # by k (checked at compile()).
     gradient_accumulation_steps: int = 1
+    # Fused multi-step dispatch: fit() stages windows of K device-resident
+    # batches and executes ONE jitted donated lax.scan over the K train
+    # steps, so per-step host work (Python dispatch, eager _repin_host
+    # transfers, callbacks bookkeeping) is paid once per WINDOW instead of
+    # once per step — the TPU-native analogue of the reference's Legion
+    # index launches over the batch partition
+    # (flexflow_dataloader.cc:260-330).  K=1 keeps the current
+    # one-dispatch-per-step behavior bit-exactly.  Semantics at K>1
+    # (docs/performance.md "Fused multi-step dispatch"):
+    #   * params/opt_state are threaded and donated across the window;
+    #     per-step losses and metric sums accumulate on device and are
+    #     fetched once per epoch;
+    #   * faults.on_step indices round UP to the window edge (a
+    #     kill_at_step:5 under K=4 fires after step 8 — the elastic
+    #     recovery matrix stays honest, tests/test_faults.py);
+    #   * checkpoint cadence (ModelCheckpoint / save_checkpoint in
+    #     callbacks) is window-aligned: epoch boundaries always are;
+    #   * composes with gradient_accumulation_steps (the accumulation
+    #     scan nests INSIDE each step of the window scan).
+    steps_per_dispatch: int = 1
+    # Opt-in padded-tail training: fit() consumes the tail samples that do
+    # not fill a whole batch (PrefetchLoader pads them to batch_size and
+    # the train step masks the padding out of loss/metrics/grads) instead
+    # of silently dropping them.  The masked step is mathematically the
+    # mean/sum over the VALID rows only; batchnorm running stats and
+    # per-microbatch dropout masks still see the padded rows (documented
+    # caveat, like gradient accumulation's batchnorm note above).
+    pad_tail_batches: bool = False
     # Sparse embedding-table updates (reference parity: the embedding
     # backward scatter-accumulates only the touched rows,
     # embedding.cu:192-228 — it never streams the full table).  A dense
@@ -240,6 +268,10 @@ class FFConfig:
                 cfg.conv_layout = val().lower()
             elif a == "--accum-steps":
                 cfg.gradient_accumulation_steps = int(val())
+            elif a == "--steps-per-dispatch":
+                cfg.steps_per_dispatch = int(val())
+            elif a == "--pad-tail":
+                cfg.pad_tail_batches = True
             # unknown flags pass through (reference forwards Legion flags)
             i += 1
         return cfg

@@ -141,32 +141,58 @@ class PrefetchLoader:
     """Double-buffered device feed: yields device-resident batches while the
     NEXT batch's host->device copy is already in flight (the reference
     overlaps its per-iteration batch copy tasks with compute the same way,
-    flexflow_dataloader.cc:260-330)."""
+    flexflow_dataloader.cc:260-330).
+
+    ``steps_per_dispatch=K`` enables WINDOW mode (:meth:`iter_windows`):
+    batches are staged as stacked ``(K, batch_size, ...)`` windows — one
+    fused K-step dispatch consumes each — again with the next window's
+    upload issued before the current one is handed out.  A window is a
+    zero-copy reshape of K contiguous batches, so staging costs nothing
+    beyond the device upload the per-batch path already paid.
+
+    ``pad_tail=True`` (opt-in) keeps the tail samples that do not fill a
+    whole batch: the last batch is zero-padded to ``batch_size`` and its
+    valid-row count rides along so the masked train step can exclude the
+    padding from loss/metrics/grads.  Off (default), the tail is dropped
+    with an info log, as before."""
 
     def __init__(self, model, inputs_data: Sequence[np.ndarray],
-                 labels: np.ndarray, batch_size: Optional[int] = None):
+                 labels: np.ndarray, batch_size: Optional[int] = None,
+                 steps_per_dispatch: int = 1, pad_tail: bool = False):
         self.model = model
         self.inputs_data = [np.asarray(a) for a in inputs_data]
         self.labels = np.asarray(labels)
         self.batch_size = batch_size or model.config.batch_size
-        self.num_batches = self.labels.shape[0] // self.batch_size
-        dropped = self.labels.shape[0] - self.num_batches * self.batch_size
-        if self.num_batches == 0:
+        self.steps_per_dispatch = max(1, int(steps_per_dispatch))
+        self.pad_tail = bool(pad_tail)
+        n = self.labels.shape[0]
+        self.num_batches = n // self.batch_size
+        dropped = n - self.num_batches * self.batch_size
+        # steps actually trained: full batches, plus the padded tail batch
+        self.num_steps = self.num_batches + (1 if self.pad_tail and dropped
+                                             else 0)
+        self.tail_valid = dropped if self.pad_tail else 0
+        # samples fit() actually consumes (THROUGHPUT denominator)
+        self.num_samples_used = self.num_batches * self.batch_size \
+            + self.tail_valid
+        if self.num_steps == 0:
             from ..fflogger import get_logger
             get_logger("ff").warning(
-                f"dataset ({self.labels.shape[0]} samples) is smaller than "
+                f"dataset ({n} samples) is smaller than "
                 f"batch_size={self.batch_size}: fit() will run ZERO steps")
-        elif dropped:
+        elif dropped and not self.pad_tail:
             from ..fflogger import get_logger
             get_logger("ff").info(
                 f"dropping {dropped} tail samples not filling a "
-                f"batch of {self.batch_size}")
+                f"batch of {self.batch_size} (pad_tail trains them)")
 
     def _host_batch(self, it: int):
         sl = slice(it * self.batch_size, (it + 1) * self.batch_size)
         return tuple(a[sl] for a in self.inputs_data) + (self.labels[sl],)
 
     def __iter__(self):
+        """Per-batch iteration (full batches only — the K=1, no-padding
+        fast path fit() has always used)."""
         if self.num_batches == 0:
             return
         pending = self.model._shard_batch(self._host_batch(0))
@@ -176,3 +202,54 @@ class PrefetchLoader:
                 # issue the next upload before handing out the current batch
                 pending = self.model._shard_batch(self._host_batch(it + 1))
             yield tuple(cur)
+
+    # ------------------------------------------------------------------
+    # window mode (FFConfig.steps_per_dispatch / pad_tail_batches)
+    # ------------------------------------------------------------------
+    def _window_bounds(self):
+        """(first_step, last_step) pairs — every window holds
+        ``steps_per_dispatch`` steps except a shorter final one."""
+        k = self.steps_per_dispatch
+        return [(lo, min(lo + k, self.num_steps))
+                for lo in range(0, self.num_steps, k)]
+
+    def _host_window(self, lo: int, hi: int):
+        """(window_arrays, nvalid) for steps [lo, hi): each array is
+        ``(hi-lo, batch_size, ...)``; nvalid is an int64 vector of valid
+        rows per step (None when padding is off)."""
+        bs = self.batch_size
+        w = hi - lo
+        arrays = []
+        padded_tail = self.tail_valid and hi == self.num_steps
+        for a in tuple(self.inputs_data) + (self.labels,):
+            chunk = a[lo * bs:hi * bs]
+            short = w * bs - chunk.shape[0]
+            if short:  # the padded tail batch closes this window
+                chunk = np.concatenate(
+                    [chunk, np.zeros((short,) + chunk.shape[1:],
+                                     chunk.dtype)])
+            arrays.append(chunk.reshape((w, bs) + chunk.shape[1:]))
+        if not self.pad_tail:
+            return tuple(arrays), None
+        nvalid = np.full((w,), bs, np.int64)
+        if padded_tail:
+            nvalid[-1] = self.tail_valid
+        return tuple(arrays), nvalid
+
+    def iter_windows(self):
+        """Yield ``(window, nvalid)`` with ``window`` device-resident and
+        the NEXT window's upload already in flight.  ``nvalid`` stays a
+        host array (the dispatch traces it as a tiny operand)."""
+        bounds = self._window_bounds()
+        if not bounds:
+            return
+        def _stage(i):
+            arrays, nvalid = self._host_window(*bounds[i])
+            return tuple(self.model._shard_window(arrays)), nvalid
+        pending = _stage(0)
+        for i in range(len(bounds)):
+            cur = pending
+            if i + 1 < len(bounds):
+                # issue the next upload before handing out this window
+                pending = _stage(i + 1)
+            yield cur

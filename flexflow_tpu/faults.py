@@ -18,6 +18,9 @@ Grammar (specs joined by ``;``, qualifiers by ``,``)::
     kill_at_step:N        exit hard (os._exit, code 17) after step N completes
     hang_at_step:N        stop making progress after step N (sleep forever —
                           detected by the supervisor's heartbeat monitor)
+                          (under fused multi-step dispatch, FFConfig.
+                          steps_per_dispatch > 1, both indices round UP to
+                          the next window edge — see :func:`on_window`)
     corrupt_ckpt:N        truncate the checkpoint published at step N
     corrupt_ckpt:latest   truncate every checkpoint this process publishes
     spawn_fail_attempt:A  supervisor-side: fail attempt A at spawn time
@@ -201,6 +204,25 @@ def on_step(step: int) -> None:
     """Train-loop hook: call after step ``step`` completes.  May sleep
     (slow_rank), stop progressing (hang_at_step) or kill the process
     (kill_at_step).  No-op without an active plan."""
+    on_window(step - 1, step)
+
+
+def on_window(start: int, end: int) -> None:
+    """Window-granularity train-loop hook: call after the fused dispatch
+    covering steps ``(start, end]`` completes (``FFConfig.
+    steps_per_dispatch`` — one host re-entry per K steps).  Fire
+    semantics, pinned by tests/test_faults.py so the elastic recovery
+    matrix stays honest when windows are enabled:
+
+    * ``kill_at_step:N`` / ``hang_at_step:N`` with ``start < N <= end``
+      fire at the WINDOW EDGE — the step index rounds up to ``end``
+      (mid-window steps never re-enter Python, so the earliest possible
+      fire point is the dispatch boundary);
+    * ``slow_rank`` sleeps ``delay`` once per covered step (``end -
+      start`` times), preserving the per-step straggler budget.
+
+    ``on_step(step)`` is exactly ``on_window(step - 1, step)``.
+    No-op without an active plan."""
     p = plan()
     if not p:
         return
@@ -210,16 +232,21 @@ def on_step(step: int) -> None:
         if spec.kind == "slow_rank":
             r = current_rank()
             if r is not None and r == int(spec.arg):
-                time.sleep(float(spec.extras.get("delay", "0.25")))
-        elif spec.kind == "hang_at_step" and step == int(spec.arg):
-            _note(f"injected hang at step {step} "
-                  f"(rank {current_rank()}, attempt {current_attempt()})")
+                time.sleep(float(spec.extras.get("delay", "0.25"))
+                           * max(1, end - start))
+        elif spec.kind == "hang_at_step" and start < int(spec.arg) <= end:
+            _note(f"injected hang at step {end}"
+                  + (f" (requested step {spec.arg} rounded up to the "
+                     f"window edge)" if int(spec.arg) != end else "")
+                  + f" (rank {current_rank()}, attempt {current_attempt()})")
             while True:  # no progress, no exit: only heartbeat monitoring
                 time.sleep(3600)  # (or the attempt timeout) can end this
-        elif spec.kind == "kill_at_step" and step == int(spec.arg):
+        elif spec.kind == "kill_at_step" and start < int(spec.arg) <= end:
             code = int(spec.extras.get("exit", str(KILL_EXIT_CODE)))
-            _note(f"injected kill at step {step} "
-                  f"(rank {current_rank()}, attempt {current_attempt()}, "
+            _note(f"injected kill at step {end}"
+                  + (f" (requested step {spec.arg} rounded up to the "
+                     f"window edge)" if int(spec.arg) != end else "")
+                  + f" (rank {current_rank()}, attempt {current_attempt()}, "
                   f"exit {code})")
             os._exit(code)  # hard crash: no cleanup, no excepthook
 

@@ -152,7 +152,11 @@ class ModelCheckpoint(Callback):
     (``{val_loss:.4f}``, ...).  For step-numbered filepaths
     (``..._step{epoch}``-style families) ``keep_last=K`` retains only
     the newest K checkpoints on disk — long elastic runs checkpoint
-    every epoch and would otherwise fill shared storage."""
+    every epoch and would otherwise fill shared storage.
+
+    Under fused multi-step dispatch (``FFConfig.steps_per_dispatch``)
+    epoch boundaries are always window boundaries, so epoch-end saves
+    stay window-aligned by construction (docs/performance.md)."""
 
     def __init__(self, filepath, monitor="val_loss", save_best_only=False,
                  mode="auto", async_write=True, verbose=0, keep_last=None):

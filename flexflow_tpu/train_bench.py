@@ -1,0 +1,147 @@
+"""``train-bench`` — dispatch-amortization microbenchmark (fused K-step
+windows vs one dispatch per step).
+
+Sibling of ``search-bench`` (search/bench.py): where that one measures
+the SEARCH hot path, this one measures the TRAIN hot path's host
+overhead.  On a dispatch-bound configuration — a model small enough that
+per-step device compute is comparable to the per-step host cost of
+re-entering Python, staging the batch and dispatching the jitted step —
+fusing K steps into ONE ``lax.scan`` dispatch
+(``FFConfig.steps_per_dispatch``) amortizes that host cost K-fold, the
+dispatch-vs-compute accounting of "A Learned Performance Model for TPUs"
+(PAPERS.md).  This bench records steps/s through the REAL ``fit()`` loop
+for K ∈ {1, 4, 8, 16} so the win is an artifact, not a claim
+(artifacts/train_bench_r*.json).
+
+Run: ``python -m flexflow_tpu.cli train-bench [--ks 1,4,8,16]
+[--steps 64] [--batch 32] [--epochs 4] [--hidden 64] [--seed 0]
+[--out artifacts/train_bench.json]`` — JSON on stdout either way.
+Fully measurable on CPU (the host overhead being amortized is exactly
+the part that does not need a TPU).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Dict
+
+import numpy as np
+
+
+def _build_model(k: int, batch_size: int, hidden: int, seed: int):
+    """Dispatch-bound small model: two dense layers on a tiny batch —
+    per-step compute is ~10s of microseconds, so per-step host work
+    dominates at K=1."""
+    import flexflow_tpu as ff
+    from flexflow_tpu.parallel.mesh import MachineMesh
+
+    cfg = ff.FFConfig(batch_size=batch_size, compute_dtype="float32",
+                      seed=seed)
+    cfg.steps_per_dispatch = k
+    m = ff.FFModel(cfg, mesh=MachineMesh({"n": 1}))
+    x = m.create_tensor((batch_size, 16), name="x")
+    t = m.dense(x, hidden, activation="relu")
+    t = m.dense(t, 10)
+    m.compile(ff.SGDOptimizer(lr=0.05), metrics=["accuracy"])
+    m.init_layers(seed=seed)
+    return m
+
+
+def _data(steps: int, batch_size: int, seed: int):
+    rng = np.random.default_rng(seed)
+    n = steps * batch_size
+    x = rng.standard_normal((n, 16)).astype(np.float32)
+    y = rng.integers(0, 10, (n, 1)).astype(np.int32)
+    return x, y
+
+
+def bench_k(k: int, steps: int = 64, batch_size: int = 32,
+            epochs: int = 4, hidden: int = 64, seed: int = 0) -> Dict:
+    """steps/s of ``fit()`` at ``steps_per_dispatch=k`` — warm epoch
+    first (pays the XLA compile for the fused-K program), then
+    ``epochs`` timed epochs fenced by fit()'s own end-of-run
+    ``block_until_ready``."""
+    import jax
+
+    model = _build_model(k, batch_size, hidden, seed)
+    x, y = _data(steps, batch_size, seed)
+    model.warmup_compile(x[:batch_size], y[:batch_size])
+    model.fit(x, y, epochs=1, verbose=False)  # warm: loader + window sizes
+    t0 = time.perf_counter()
+    model.fit(x, y, epochs=epochs, verbose=False)
+    jax.block_until_ready(model._params)
+    dt = time.perf_counter() - t0
+    n_steps = steps * epochs
+    return {
+        "steps_per_dispatch": k,
+        "steps_timed": n_steps,
+        "steps_per_sec": round(n_steps / dt, 2),
+        "ms_per_step": round(dt / n_steps * 1e3, 4),
+        "dispatches": -(-steps // k) * epochs,
+        "batch_size": batch_size,
+        "final_loss": round(float(model.last_epoch_losses[-1]), 6),
+    }
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        prog="flexflow-tpu train-bench",
+        description="dispatch-amortization microbenchmark: fit() steps/s "
+                    "across steps_per_dispatch values "
+                    "(docs/performance.md)")
+    ap.add_argument("--ks", default="1,4,8,16",
+                    help="comma-separated steps_per_dispatch values")
+    ap.add_argument("--steps", type=int, default=64,
+                    help="train steps per epoch (dataset size / batch)")
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--epochs", type=int, default=4,
+                    help="timed epochs per K (one warm epoch on top)")
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default="",
+                    help="also write the JSON artifact here")
+    args = ap.parse_args(argv)
+    ks = [int(v) for v in args.ks.split(",") if v.strip()]
+    if any(v < 1 for v in ks):
+        ap.error(f"--ks values must be >= 1, got {ks}")
+
+    # silence the per-epoch JSON events while benching: this bench's
+    # stdout IS the payload, and the event stream would interleave with
+    # it (restored after — in-process callers keep their logging)
+    from .fflogger import get_logger
+    log = get_logger("ff")
+    prev_level = log.level
+    log.level = 100
+
+    import jax
+    try:
+        results = [bench_k(k, steps=args.steps, batch_size=args.batch,
+                           epochs=args.epochs, hidden=args.hidden,
+                           seed=args.seed)
+                   for k in ks]
+    finally:
+        log.level = prev_level
+    base = next((r for r in results if r["steps_per_dispatch"] == 1),
+                results[0])
+    for r in results:
+        r["speedup_vs_k1"] = round(
+            r["steps_per_sec"] / base["steps_per_sec"], 3)
+    payload = {
+        "bench": "train-bench",
+        "backend": jax.default_backend(),
+        "steps_per_epoch": args.steps,
+        "results": results,
+    }
+    text = json.dumps(payload, indent=2)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+        print(f"# wrote {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,13 @@ by tier-1 ``tests/test_static_checks.py``).  Rules:
   ``np.random.*`` draws make failures irreproducible; tests must use
   ``np.random.default_rng(seed)`` / ``random.Random(seed)`` /
   ``jax.random.PRNGKey(seed)``.
+* **RL004 — no per-step host syncs in train/eval batch loops**: inside
+  the batch loops of ``fit``/``evaluate``/``predict`` in
+  ``flexflow_tpu/``, a ``float(...)``, ``np.asarray(...)`` or
+  ``jax.device_get(...)`` fences the async dispatch pipeline every
+  batch (ISSUE 4's fused-dispatch fix: accumulate on device, fetch
+  ONCE after the loop).  The per-EPOCH loop (``for epoch in ...``) is
+  exempt — an epoch-boundary fetch is the intended sync point.
 
 Exit 0 when clean, 1 with ``file:line: RLxxx message`` findings on
 stdout.  No third-party deps — must run on a bare CPython.
@@ -57,6 +64,13 @@ def _rel(path: str) -> str:
     return os.path.relpath(path, REPO).replace(os.sep, "/")
 
 
+# host-sync call sites banned inside fit/evaluate/predict batch loops
+# (RL004): each fences the device queue when applied to a live jax array
+_RL004_BANNED = {"float", "np.asarray", "numpy.asarray", "jax.device_get",
+                 "jax.block_until_ready"}
+_RL004_FUNCS = ("fit", "evaluate", "predict")
+
+
 class _Visitor(ast.NodeVisitor):
     def __init__(self, relpath: str):
         self.relpath = relpath
@@ -67,6 +81,8 @@ class _Visitor(ast.NodeVisitor):
             relpath.startswith("flexflow_tpu/strategy/")
             or relpath == "flexflow_tpu/parallel/sharding.py")
         self.in_tests = relpath.startswith("tests/")
+        self._hot_func: Optional[str] = None  # inside fit/evaluate/predict
+        self._batch_loops = 0                 # nested non-epoch loop depth
 
     def _add(self, node: ast.AST, code: str, msg: str) -> None:
         self.findings.append((node.lineno, code, msg))
@@ -77,7 +93,55 @@ class _Visitor(ast.NodeVisitor):
             self._check_savez(node, name)
             self._check_warn(node, name)
             self._check_rng(node, name)
+            self._check_step_sync(node, name)
         self.generic_visit(node)
+
+    # --- RL004 scope tracking -----------------------------------------
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        hot = (self.in_library and node.name in _RL004_FUNCS)
+        prev_f, prev_l = self._hot_func, self._batch_loops
+        if hot:
+            self._hot_func, self._batch_loops = node.name, 0
+        self.generic_visit(node)
+        self._hot_func, self._batch_loops = prev_f, prev_l
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _visit_loop(self, node) -> None:
+        # the per-EPOCH loop is the sanctioned once-per-epoch sync point;
+        # every other loop in a hot function iterates batches/windows
+        target = getattr(node, "target", None)
+        is_epoch = isinstance(target, ast.Name) and target.id == "epoch"
+        scoped = self._hot_func is not None and not is_epoch
+        # a For's iter expression runs ONCE per loop entry (e.g.
+        # `for s in jax.device_get(sums):` is the once-after-the-loop
+        # idiom) — scan it OUTSIDE the batch-loop scope
+        if isinstance(node, ast.For):
+            self.visit(node.target)
+            self.visit(node.iter)
+        if scoped:
+            self._batch_loops += 1
+        # a While's test RE-EVALUATES every iteration (`while
+        # float(loss) > tol:` fences per iteration) — scan it INSIDE
+        if isinstance(node, ast.While):
+            self.visit(node.test)
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+        if scoped:
+            self._batch_loops -= 1
+
+    visit_For = _visit_loop
+    visit_While = _visit_loop
+
+    def _check_step_sync(self, node: ast.Call, name: str) -> None:
+        if self._hot_func is None or self._batch_loops == 0:
+            return
+        if name in _RL004_BANNED:
+            self._add(node, "RL004",
+                      f"{name}() inside the {self._hot_func}() batch loop "
+                      f"fences the async dispatch pipeline every batch — "
+                      f"keep sums/outputs on device and fetch once after "
+                      f"the loop (docs/performance.md)")
 
     def _check_savez(self, node: ast.Call, name: str) -> None:
         if not self.in_library or self.is_resilience:

@@ -344,3 +344,122 @@ def test_free_port_avoids_previous():
     p1 = free_port()
     for _ in range(8):  # the avoid set must hold even under immediate reuse
         assert free_port(avoid=(p1,)) != p1
+
+
+# ----------------------------------------------------------------------
+# window-boundary fault semantics (fused multi-step dispatch, ISSUE 4:
+# FFConfig.steps_per_dispatch > 1 re-enters Python once per K-step
+# window, so kill/hang step indices round UP to the window edge)
+# ----------------------------------------------------------------------
+def test_on_step_is_on_window_of_one(fault_env):
+    """on_step(N) ≡ on_window(N-1, N): the K=1 contract is unchanged."""
+    fault_env("slow_rank:0,delay=0.05", rank=0)
+    t0 = time.monotonic()
+    faults.on_window(2, 3)
+    assert time.monotonic() - t0 >= 0.05
+
+
+def test_slow_rank_scales_with_window_width(fault_env):
+    """slow_rank preserves the per-STEP straggler budget: a K-step
+    window sleeps K times the delay."""
+    fault_env("slow_rank:0,delay=0.02", rank=0)
+    t0 = time.monotonic()
+    faults.on_window(0, 4)
+    assert time.monotonic() - t0 >= 0.08
+
+
+_WINDOW_LOADER = """
+import importlib.util, sys
+spec = importlib.util.spec_from_file_location("ff_faults", {faults_py!r})
+m = importlib.util.module_from_spec(spec)
+sys.modules["ff_faults"] = m
+spec.loader.exec_module(m)
+m.set_rank(0)
+k = int(sys.argv[1])
+for end in range(k, 13, k):     # window edges: k, 2k, ... (12 steps)
+    m.on_window(end - k, end)
+    print("edge", end, flush=True)
+print("survived")
+"""
+
+
+def test_kill_rounds_up_to_window_edge(tmp_path):
+    """kill_at_step:5 under K=4 windows fires at the step-8 edge: the
+    step-4 edge passes, step 8 dies — and the injection note names both
+    the rounded edge and the requested step (the elastic matrix reads
+    these tails)."""
+    loader = _WINDOW_LOADER.format(faults_py=FAULTS_PY)
+    env = dict(os.environ, FF_FAULT="kill_at_step:5")
+    r = subprocess.run([sys.executable, "-c", loader, "4"], env=env,
+                       capture_output=True, text=True, timeout=30)
+    assert r.returncode == faults.KILL_EXIT_CODE
+    assert "edge 4" in r.stdout          # the window BEFORE the fault ran
+    assert "edge 8" not in r.stdout      # died at the step-8 dispatch edge
+    assert "injected kill at step 8" in r.stderr
+    assert "requested step 5 rounded up" in r.stderr
+
+
+def test_kill_exact_window_edge_no_rounding_note(tmp_path):
+    """A fault index that IS a window edge fires there, un-rounded."""
+    loader = _WINDOW_LOADER.format(faults_py=FAULTS_PY)
+    env = dict(os.environ, FF_FAULT="kill_at_step:8")
+    r = subprocess.run([sys.executable, "-c", loader, "4"], env=env,
+                       capture_output=True, text=True, timeout=30)
+    assert r.returncode == faults.KILL_EXIT_CODE
+    assert "injected kill at step 8" in r.stderr
+    assert "rounded up" not in r.stderr
+    # K=1 windows degrade to exact per-step semantics
+    r1 = subprocess.run([sys.executable, "-c", loader, "1"], env=env,
+                        capture_output=True, text=True, timeout=30)
+    assert r1.returncode == faults.KILL_EXIT_CODE
+    assert "edge 7" in r1.stdout and "edge 8" not in r1.stdout
+
+
+def test_hang_rounds_up_to_window_edge():
+    """hang_at_step mid-window stops progress at the window edge (the
+    supervisor's heartbeat monitor is what ends it; here a timeout)."""
+    loader = _WINDOW_LOADER.format(faults_py=FAULTS_PY)
+    env = dict(os.environ, FF_FAULT="hang_at_step:3")
+    with pytest.raises(subprocess.TimeoutExpired) as ei:
+        subprocess.run([sys.executable, "-c", loader, "4"], env=env,
+                       capture_output=True, text=True, timeout=3)
+    out = (ei.value.stdout or b"").decode(errors="replace")
+    err = (ei.value.stderr or b"").decode(errors="replace")
+    assert "edge 4" not in out           # hung INSIDE the first edge hook
+    assert "injected hang at step 4" in err
+    assert "requested step 3 rounded up" in err
+
+
+def test_fit_window_kill_fires_at_edge(tmp_path):
+    """End-to-end: a real fit() under steps_per_dispatch=4 killed by
+    FF_FAULT=kill_at_step:2 dies at the step-4 window edge — mid-window
+    steps never re-enter Python, so the PR 2 elastic matrix's step
+    accounting holds at window granularity."""
+    worker = textwrap.dedent("""
+        import numpy as np
+        import flexflow_tpu as ff
+        from flexflow_tpu.parallel.mesh import MachineMesh
+
+        cfg = ff.FFConfig(batch_size=8, compute_dtype="float32")
+        cfg.steps_per_dispatch = 4
+        m = ff.FFModel(cfg, mesh=MachineMesh({"n": 1}))
+        x = m.create_tensor((8, 4), name="x")
+        m.dense(x, 3)
+        m.compile(ff.SGDOptimizer(lr=0.1))
+        m.init_layers(seed=0)
+        rng = np.random.default_rng(0)
+        xv = rng.standard_normal((8 * 8, 4)).astype(np.float32)
+        yv = rng.integers(0, 3, (8 * 8, 1)).astype(np.int32)
+        m.fit(xv, yv, epochs=1, verbose=False)
+        print("survived")
+    """)
+    from tests.subproc import cached_env
+    env = cached_env()
+    env.update(FF_FAULT="kill_at_step:2", JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "-c", worker], env=env,
+                       capture_output=True, text=True, timeout=300,
+                       cwd=REPO)
+    assert r.returncode == faults.KILL_EXIT_CODE, (r.returncode, r.stderr)
+    assert "survived" not in r.stdout
+    assert "injected kill at step 4" in r.stderr
+    assert "requested step 2 rounded up" in r.stderr

@@ -114,6 +114,47 @@ def test_static_checks_script_passes_on_repo():
      "import numpy as np\nr = np.random.default_rng(0)\n"
      "x = r.standard_normal(3)\n",
      None),
+    # RL004: a float() host sync inside an evaluate() batch loop fences
+    # the async dispatch pipeline every batch (ISSUE 4)
+    ("flexflow_tpu/zz_bad_sync.py",
+     "class M:\n"
+     "    def evaluate(self, x, y):\n"
+     "        s = 0.0\n"
+     "        for b in self.loader:\n"
+     "            s += float(self.step(b))\n"
+     "        return s\n",
+     "RL004"),
+    # the per-EPOCH loop is the sanctioned sync point, and fetching in
+    # the loop's ITER expression (once per loop entry) is the idiom
+    ("flexflow_tpu/zz_ok_sync.py",
+     "import jax\n\n"
+     "class M:\n"
+     "    def fit(self, x, y):\n"
+     "        for epoch in range(2):\n"
+     "            sums = []\n"
+     "            for batch in self.loader:\n"
+     "                sums.append(self.step(batch))\n"
+     "            for s in jax.device_get(sums):\n"
+     "                self.pm.update(s)\n"
+     "            v = float(self.val_loss)\n"
+     "        return v\n",
+     None),
+    # outside fit/evaluate/predict the rule does not engage
+    ("flexflow_tpu/zz_ok_other.py",
+     "def gather(items):\n"
+     "    out = []\n"
+     "    for it in items:\n"
+     "        out.append(float(it))\n"
+     "    return out\n",
+     None),
+    # a while-loop TEST re-evaluates per iteration: syncs there are
+    # per-step syncs too
+    ("flexflow_tpu/zz_bad_while.py",
+     "class M:\n"
+     "    def fit(self, x, y):\n"
+     "        while float(self.loss) > 0.1:\n"
+     "            self.step()\n",
+     "RL004"),
 ])
 def test_repo_lint_rules(tmp_path, rel, src, code):
     """repo_lint unit check on synthetic files, laid out under tmp_path
