@@ -104,9 +104,12 @@ STEPS_PER_DISPATCH = max(1, int(os.environ.get("FF_BENCH_K", "1")))
 # backward pass, so the crossover for the full step may sit lower.
 FLASH = "auto"
 
-# sweep order: headline first so an interrupted sweep still records it
+# sweep order: headline first so an interrupted sweep still records it.
+# "serving" is the inference-engine row (flexflow_tpu/serving serve-bench
+# at a fixed trace) so BENCH_*.json tracks the serving path alongside
+# training.
 SWEEP = ["inception_v3", "alexnet", "resnet50", "nmt", "transformer",
-         "dlrm", "candle_uno"]
+         "dlrm", "candle_uno", "serving"]
 
 # best measured per-chip batch size per workload (v5e, BASELINE.md)
 DEFAULT_BATCH = {"inception_v3": 128, "alexnet": 512, "resnet50": 128,
@@ -356,9 +359,43 @@ def _hbm_bytes_per_step(model, batch_size, n_chips):
     return emb / max(1, n_chips) + params
 
 
+def bench_serving(batch_size):
+    """One serving row: engine rows/s at the serve-bench fixed trace
+    (seeded request mix) vs naive per-request predict — the inference
+    analogue of the training rows, measurable on any backend (the
+    amortized dispatch overhead needs no TPU)."""
+    from flexflow_tpu.fflogger import silenced
+    from flexflow_tpu.serving.bench import run_serve_bench
+
+    # silence the serve_stats/epoch event streams: this harness's
+    # stdout protocol is one JSON row per model, and a stray event
+    # line would be what _parse_child_row picks up if a later phase
+    # crashes (same reason serve-bench's own main() silences them)
+    with silenced("ff", "serve"):
+        payload = run_serve_bench(requests=256,
+                                  max_batch=batch_size or 64, seed=0)
+    eng, naive = payload["engine"], payload["naive"]
+    return {
+        "metric": "serving_engine_rows_per_sec",
+        "value": eng["qps_rows"],
+        "unit": "rows/s",
+        "vs_baseline": None,
+        "qps_requests": eng["qps_requests"],
+        "speedup_vs_naive": payload["speedup_rows"],
+        "naive_rows_per_sec": naive["qps_rows"],
+        "p50_ms": payload["paced"]["p50_ms"],
+        "p95_ms": payload["paced"]["p95_ms"],
+        "p99_ms": payload["paced"]["p99_ms"],
+        "batch_occupancy": eng["batch_occupancy"],
+        "batch_size": batch_size or 64,
+    }
+
+
 def bench_model(model_name, batch_size, iters):
     import jax
 
+    if model_name == "serving":
+        return bench_serving(batch_size)
     batch_size = batch_size or DEFAULT_BATCH.get(model_name, 128)
     model, xs, y = build(model_name, batch_size)
     n_chips = len(jax.devices())
@@ -621,7 +658,9 @@ def run_sweep(sweep, batch_size=0, iters=20, budget_s=1500.0,
             compact[name] = {k: row[k] for k in
                              ("value", "ms_per_step", "tflops_per_chip",
                               "mfu", "vs_baseline", "batch_size",
-                              "hbm_bw_util") if row.get(k) is not None}
+                              "hbm_bw_util", "qps_requests",
+                              "speedup_vs_naive", "p50_ms", "p99_ms")
+                             if row.get(k) is not None}
     summary = {
         "metric": head.get("metric", "bench_sweep"),
         "value": head.get("value"),

@@ -34,6 +34,12 @@ def main(argv=None) -> None:
         from .train_bench import main as train_bench_main
         train_bench_main(argv[1:])
         return
+    if argv and argv[0] == "serve-bench":
+        # serving-engine microbenchmark: bucketed AOT + micro-batching
+        # vs naive per-request predict (JSON to stdout; docs/serving.md)
+        from .serving.bench import main as serve_bench_main
+        serve_bench_main(argv[1:])
+        return
     if argv and argv[0] == "elastic":
         # supervised multi-process training with restart-from-checkpoint
         # (docs/elastic.md)
@@ -52,11 +58,13 @@ def main(argv=None) -> None:
               "<script.py> [script args]\n"
               "       flexflow-tpu search-bench [flags]\n"
               "       flexflow-tpu train-bench [flags]\n"
+              "       flexflow-tpu serve-bench [flags]\n"
               "       flexflow-tpu lint --model NAME [--strategy s.pb] "
               "[--devices N] [--json]\n"
               "flags (reference model.cc:1221-1289): -e -b --lr --wd -d "
               "--budget --alpha -s/-import -ll:tpu -ll:cpu --nodes "
-              "--profiling --seed --remat --steps-per-dispatch --pad-tail",
+              "--profiling --seed --remat --steps-per-dispatch --pad-tail "
+              "--serve-max-batch --serve-max-wait-ms --serve-buckets",
               file=sys.stderr)
         raise SystemExit(2)
     flags = [a for a in argv if a != script]

@@ -26,10 +26,33 @@ def default_dir() -> str:
         ".jax_cache_chip")
 
 
+def _resolve_dir(cache_dir: str | None) -> tuple[str, bool]:
+    """(directory, explicit) for an ``enable()`` call: an argument or an
+    ``FF_CACHE_DIR`` env override is EXPLICIT (the operator picked the
+    surface); the built-in default is not, and must never displace a
+    cache dir some other harness already configured (e.g. the test
+    suite's session-scoped ``.jax_cache`` — mixing surfaces can abort
+    the reader, see below)."""
+    if cache_dir is not None:
+        return cache_dir, True
+    env = os.environ.get("FF_CACHE_DIR")
+    if env:
+        return env, True
+    return default_dir(), False
+
+
 def enable(cache_dir: str | None = None) -> None:
     """Point jax at the repo-level ``.jax_cache_chip`` (or
-    ``cache_dir``).  ``FF_BENCH_NO_CACHE=1`` opts out (A/B hygiene when
-    timing compiles).  Never raises: the cache is an optimization.
+    ``cache_dir``, or the ``FF_CACHE_DIR`` env override).
+    ``FF_BENCH_NO_CACHE=1`` opts out (A/B hygiene when timing
+    compiles).  Never raises: the cache is an optimization.
+
+    Idempotent: repeated calls with the same resolved directory do not
+    churn jax.config, and a DEFAULT call (no argument, no env) defers
+    to any cache dir already configured — the serving engine calls
+    ``enable()`` unconditionally at startup, which must be a no-op
+    under harnesses (tests/conftest.py, bench.py) that already picked
+    their surface.
 
     Deliberately a DIFFERENT directory from the test suite's
     ``.jax_cache`` (tests/subproc.CACHE_DIR): chip-side processes (axon
@@ -40,11 +63,15 @@ def enable(cache_dir: str | None = None) -> None:
     abort in the suite).  One surface, one cache."""
     if os.environ.get("FF_BENCH_NO_CACHE"):
         return
-    if cache_dir is None:
-        cache_dir = default_dir()
+    cache_dir, explicit = _resolve_dir(cache_dir)
     try:
         import jax
 
+        current = jax.config.jax_compilation_cache_dir
+        if current == cache_dir:
+            return  # already on this surface; don't churn jax.config
+        if current and not explicit:
+            return  # a harness already picked a surface; keep it
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         # Cache only compiles that cost real time: the tiny-jit entries
         # (bernoulli, broadcast, ...) are cheap to redo but multiply the

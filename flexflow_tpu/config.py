@@ -188,6 +188,24 @@ class FFConfig:
     # per-microbatch dropout masks still see the padded rows (documented
     # caveat, like gradient accumulation's batchnorm note above).
     pad_tail_batches: bool = False
+    # Serving engine knobs (flexflow_tpu/serving, docs/serving.md).
+    # serve_max_batch: largest packed micro-batch the inference engine
+    # dispatches (0 = batch_size); also the largest shape bucket, so the
+    # AOT warmup compiles every bucket up to it at startup.
+    serve_max_batch: int = 0
+    # serve_max_wait_ms: micro-batcher coalescing deadline — a pending
+    # request is dispatched no later than this many ms after it was
+    # submitted, even if the batch is not full (latency floor under
+    # light load; under heavy load batches fill before the deadline).
+    serve_max_wait_ms: float = 2.0
+    # serve_buckets: explicit comma-separated batch buckets ("2,4,16,64");
+    # empty = powers of two 2,4,...,serve_max_batch (the default omits
+    # bucket 1 to keep results packing-invariant — single-row programs
+    # hit matrix-vector kernels whose bits differ ~1 ulp; opt in via an
+    # explicit list, see serving/batcher.derive_buckets).  Each bucket
+    # is lowered + AOT-compiled once at engine startup
+    # (FFModel.forward_compiled) and reused for every packed batch.
+    serve_buckets: str = ""
     # Sparse embedding-table updates (reference parity: the embedding
     # backward scatter-accumulates only the touched rows,
     # embedding.cu:192-228 — it never streams the full table).  A dense
@@ -272,6 +290,12 @@ class FFConfig:
                 cfg.steps_per_dispatch = int(val())
             elif a == "--pad-tail":
                 cfg.pad_tail_batches = True
+            elif a == "--serve-max-batch":
+                cfg.serve_max_batch = int(val())
+            elif a == "--serve-max-wait-ms":
+                cfg.serve_max_wait_ms = float(val())
+            elif a == "--serve-buckets":
+                cfg.serve_buckets = val()
             # unknown flags pass through (reference forwards Legion flags)
             i += 1
         return cfg

@@ -17,6 +17,7 @@ runtime-controlled levels.  TPU-native shape:
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import sys
@@ -79,3 +80,22 @@ def get_logger(name: str) -> Category:
     if name not in _registry:
         _registry[name] = Category(name)
     return _registry[name]
+
+
+@contextlib.contextmanager
+def silenced(*names: str):
+    """Temporarily mute the given categories' info-level output
+    (levels restored on exit) — for harnesses whose stdout IS a JSON
+    payload and must not interleave with the event stream
+    (train-bench, serve-bench, bench.py's serving row).  Warnings and
+    errors stay visible: they go to stderr, which cannot corrupt the
+    stdout payload, and a failing bench run needs its diagnostics."""
+    logs = [get_logger(n) for n in names]
+    prev = [log.level for log in logs]
+    for log in logs:
+        log.level = _LEVELS["info"] + 1  # events + info off, warn+ on
+    try:
+        yield
+    finally:
+        for log, lvl in zip(logs, prev):
+            log.level = lvl

@@ -123,6 +123,11 @@ class FFModel:
         self._cached_logits = None
         self._cached_grads = None
         self._cached_metric_sums = None
+        # shape-bucketed AOT inference executables (forward_compiled) and
+        # the per-batch-size zero label feeds they consume — both keyed
+        # on batch size, both reused across predict()/serving calls
+        self._fwd_compiled: Dict[int, Any] = {}
+        self._dummy_labels: Dict[int, np.ndarray] = {}
         self.perf_metrics = metrics_mod.PerfMetrics()
 
     # ------------------------------------------------------------------
@@ -936,6 +941,9 @@ class FFModel:
                 logits, labels, metric_names, loss_type, nvalid=nvalid)
             return preds, loss_sum, sums
 
+        # a re-compile invalidates any AOT bucket executables lowered
+        # from the previous _jit_forward (serving/predict re-warm lazily)
+        self._fwd_compiled = {}
         donate = (0, 1)
         self._train_step = jax.jit(train_step, donate_argnums=donate)
         self._train_window = jax.jit(window_step, donate_argnums=donate)
@@ -1250,16 +1258,43 @@ class FFModel:
                 shape[i] % self.mesh.axis_size(ax) == 0 else None
                 for i, ax in enumerate(spec)]
 
-    def _shard_batch(self, arrays):
+    def _shard_batch(self, arrays, entries_fn=None):
+        """Place batch arrays under the mesh; ``entries_fn`` picks the
+        PartitionSpec entries per array (default: the training-batch
+        spec; inference passes `_infer_batch_entries` so placement and
+        the AOT lowering share one spec source)."""
+        entries_fn = entries_fn or self._batch_entries
         out = []
         for a in arrays:
             a = jnp.asarray(a)
             if self.mesh is not None and self.mesh.is_distributed:
-                entries = self._batch_entries(a.shape, a.dtype)
+                entries = entries_fn(a.shape, a.dtype)
                 a = self._put_global(
                     a, self.mesh.sharding(jax.sharding.PartitionSpec(*entries)))
             out.append(a)
         return out
+
+    def _infer_batch_entries(self, shape, dtype):
+        """Inference-batch PartitionSpec entries: :meth:`_batch_entries`
+        with ONE extra rule — never shard the batch dim below 2 rows
+        per shard.  A 1-row shard lowers the matmuls to matrix-VECTOR
+        kernels whose accumulation order differs ~1 ulp from the
+        matrix-matrix path, so a request's bits would depend on which
+        bucket the batcher packed it into; serving promises
+        packing-invariant results (tests/test_serving.py pins engine ==
+        predict bit-identically across buckets)."""
+        entries = self._batch_entries(shape, dtype)
+        if (entries and entries[0] is not None
+                and shape[0] < 2 * self.mesh.axis_size(entries[0])):
+            entries = [None] + list(entries[1:])
+        return entries
+
+    def _shard_infer_batch(self, arrays):
+        """Place an inference batch exactly as the bucket executables
+        (:meth:`forward_compiled`) were lowered to expect — AOT
+        compiled programs validate input shardings, so placement and
+        lowering must share one spec source (`_infer_batch_entries`)."""
+        return self._shard_batch(arrays, self._infer_batch_entries)
 
     def _shard_window(self, arrays):
         """Place stacked ``(w, batch...)`` window arrays (fused multi-step
@@ -1656,6 +1691,56 @@ class FFModel:
         denom = max(1, total) if self._loss_reduction == "mean" else 1
         return loss_sum / denom, pm
 
+    # ------------------------------------------------------------------
+    # inference: shape-bucketed AOT executables (docs/serving.md)
+    # ------------------------------------------------------------------
+    def _dummy_label(self, bs: int) -> np.ndarray:
+        """The zero label feed inference dispatches carry (the fused
+        forward signature includes the label slot), cached per batch
+        size — predict()/serving reuse it every call instead of
+        re-allocating it per dispatch."""
+        lab = self._dummy_labels.get(bs)
+        if lab is None:
+            lab = np.zeros((bs,) + tuple(self.label_tensor.shape[1:]),
+                           self.label_tensor.dtype)
+            self._dummy_labels[bs] = lab
+        return lab
+
+    def forward_compiled(self, bucket_bs: int):
+        """The inference forward AOT-lowered and compiled at batch size
+        ``bucket_bs`` (``jax.jit(...).lower(...).compile()``), cached
+        per bucket — compile once at startup, then every dispatch of
+        that shape reuses the executable with zero retrace/cache-lookup
+        ambiguity.  The serving engine warms one executable per shape
+        bucket this way; ``predict()`` routes through the same cache.
+        Call as ``forward_compiled(bs)(model._params, batch)`` where
+        ``batch`` is ``(*inputs, dummy_label)`` shaped ``(bs, ...)``
+        and placed like :meth:`_shard_batch` places it (params are
+        passed per call — pinned on device, never donated)."""
+        assert self._compiled, "call compile() first"
+        key = int(bucket_bs)
+        if key < 1:
+            raise ValueError(f"bucket batch size must be >= 1, got "
+                             f"{bucket_bs}")
+        cached = self._fwd_compiled.get(key)
+        if cached is not None:
+            return cached
+        specs = []
+        for t in list(self.input_tensors) + [self.label_tensor]:
+            shape = (key,) + tuple(t.shape[1:])
+            dtype = jnp.dtype(t.dtype)
+            sharding = None
+            if self.mesh is not None and self.mesh.is_distributed:
+                entries = self._infer_batch_entries(shape, dtype)
+                sharding = self.mesh.sharding(
+                    jax.sharding.PartitionSpec(*entries))
+            specs.append(jax.ShapeDtypeStruct(shape, dtype,
+                                              sharding=sharding))
+        compiled = self._jit_forward.lower(self._params,
+                                           tuple(specs)).compile()
+        self._fwd_compiled[key] = compiled
+        return compiled
+
     # predict()'s device-side logit accumulation drains to host whenever
     # this many elements are pending (~256 MB of f32): typical calls get
     # ONE transfer at the end, while a huge-dataset x wide-head predict
@@ -1664,16 +1749,28 @@ class FFModel:
     _PREDICT_DRAIN_ELEMS = 1 << 26
 
     def predict(self, x, batch_size: Optional[int] = None) -> np.ndarray:
-        """Batched inference.  Per-batch logits stack up ON DEVICE and
-        drain to host in bounded chunks (one transfer total for typical
-        sizes) — the old per-batch ``np.asarray`` fenced the async
-        pipeline every batch (repo_lint RL004)."""
+        """Batched inference through the bucket executable for
+        ``batch_size`` (:meth:`forward_compiled` — compiled once,
+        shared with the serving engine's AOT cache).  Per-batch logits
+        stack up ON DEVICE and drain to host in bounded chunks (one
+        transfer total for typical sizes) — the old per-batch
+        ``np.asarray`` fenced the async pipeline every batch
+        (repo_lint RL004)."""
         xs = x if isinstance(x, (list, tuple)) else [x]
+        if len(xs) != len(self.input_tensors):
+            raise ValueError(
+                f"model has {len(self.input_tensors)} input(s), got "
+                f"{len(xs)}")
+        # coerce to the declared input dtypes up front: the AOT
+        # executable is compiled for them (the old per-call jit would
+        # silently retrace for an int feed to a float input; one cast
+        # here keeps that working and matches ServingEngine.submit)
+        xs = [np.asarray(a, dtype=t.dtype)
+              for a, t in zip(xs, self.input_tensors)]
         n = xs[0].shape[0]
         bs = batch_size or self.config.batch_size
-        dummy_label = np.zeros(
-            (bs,) + tuple(self.label_tensor.shape[1:]),
-            self.label_tensor.dtype)
+        dummy_label = self._dummy_label(bs)
+        fwd = self.forward_compiled(bs)
         pending: List[jax.Array] = []
         host: List[np.ndarray] = []
 
@@ -1686,9 +1783,11 @@ class FFModel:
         pending_elems = 0
         for it in range(-(-n // bs)):
             lo, hi = it * bs, min(n, (it + 1) * bs)
-            arrs = self._pad_tail(tuple(a[lo:hi] for a in xs), bs)
-            batch = tuple(self._shard_batch(arrs + (dummy_label,)))
-            out = self._jit_forward(self._params, batch)
+            arrs = tuple(a[lo:hi] for a in xs)
+            if hi - lo < bs:  # exact batches skip the pad path entirely
+                arrs = self._pad_tail(arrs, bs)
+            batch = tuple(self._shard_infer_batch(arrs + (dummy_label,)))
+            out = fwd(self._params, batch)
             pending.append(out)
             pending_elems += out.size
             if pending_elems >= self._PREDICT_DRAIN_ELEMS:

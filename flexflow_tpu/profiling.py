@@ -115,6 +115,28 @@ def profile_op(op: Op, compute_dtype: str = "bfloat16", warmup: int = 2,
     return {"fwd_ms": fwd_ms, "bwd_ms": bwd_ms}
 
 
+def quantiles(samples, qs=(0.5, 0.95, 0.99)) -> Dict[float, float]:
+    """Nearest-rank quantiles of a sample sequence — the p50/p95/p99
+    latency accounting shared by the serving metrics
+    (flexflow_tpu/serving/metrics.py) and serve-bench.  Nearest-rank
+    (not interpolated): every reported value is a latency that actually
+    happened, which is what a tail-latency SLO compares against.
+    Returns ``{q: value}``; empty input yields NaNs."""
+    xs = sorted(samples)
+    if not xs:
+        return {q: float("nan") for q in qs}
+    n = len(xs)
+    return {q: float(xs[min(n - 1, _nearest_rank(q, n))]) for q in qs}
+
+
+def _nearest_rank(q: float, n: int) -> int:
+    """0-based nearest-rank index: ceil(q*n) - 1, computed in exact
+    integer arithmetic for the common x.xx quantiles so float jitter
+    (0.95*20 == 18.999...96) cannot shift the rank."""
+    num = int(round(q * 10000))
+    return max(0, -(-num * n // 10000) - 1)
+
+
 def time_calls(fn, min_time_s: float = 0.3, max_calls: int = 1_000_000
                ) -> Tuple[float, int]:
     """(calls/sec, n_calls) of repeatedly invoking ``fn()`` until at

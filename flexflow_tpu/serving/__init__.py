@@ -1,0 +1,12 @@
+"""flexflow_tpu.serving — the inference-serving subsystem
+(docs/serving.md): shape-bucketed AOT executables + a dynamic
+micro-batcher over a compiled FFModel, with rolling serving metrics and
+the ``flexflow-tpu serve-bench`` harness."""
+
+from .batcher import (MicroBatcher, Request, bucket_for, derive_buckets,
+                      split_sizes)
+from .engine import ServingEngine
+from .metrics import ServingMetrics
+
+__all__ = ["ServingEngine", "MicroBatcher", "Request", "ServingMetrics",
+           "bucket_for", "derive_buckets", "split_sizes"]

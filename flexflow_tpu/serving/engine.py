@@ -1,0 +1,340 @@
+"""ServingEngine — shape-bucketed AOT executables + dynamic
+micro-batching over a compiled FFModel (docs/serving.md).
+
+The training side amortizes host cost with fused multi-step dispatch
+(PR 4); this is the inference analogue for a request-serving loop, in
+the spirit of TVM's ahead-of-time specialized executables applied to
+serving: compile ONCE per shape bucket at startup
+(``jax.jit(...).lower(...).compile()`` via
+:meth:`FFModel.forward_compiled`, warmed through the persistent compile
+cache), then keep the device saturated with dynamically packed
+micro-batches.  Per dispatch the engine pays exactly one device
+execution and one ``jax.device_get`` for the whole packed batch — no
+per-request host sync (repo_lint RL005 locks the scatter loop down the
+same way RL004 locks fit/evaluate/predict).
+
+Threading model: any number of producer threads call :meth:`submit`
+(returns a ``concurrent.futures.Future``); ONE dispatcher thread owns
+all jax work — it pulls coalesced batches from the
+:class:`~flexflow_tpu.serving.batcher.MicroBatcher`, packs them into
+the smallest covering bucket, runs the bucket executable with the
+model's device-pinned params (passed per call, never donated, never
+re-pinned), fetches once, and scatters per-request row slices back to
+the futures.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future, InvalidStateError
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..compile_cache import enable as _enable_compile_cache
+from .batcher import (MicroBatcher, Request, bucket_for, derive_buckets,
+                      split_sizes)
+from .metrics import ServingMetrics
+
+
+def _resolve_future(fut: Future, out) -> bool:
+    """Complete ``fut`` with a result or exception, tolerating client
+    interference: ``set_running_or_notify_cancel()`` atomically claims
+    a pending future (after which a client ``cancel()`` can no longer
+    race the ``set_result``) and reports a future the client already
+    cancelled, which the engine simply drops — a cancelled or
+    double-completed future must never raise on the dispatcher thread
+    (an escaped InvalidStateError would kill the dispatcher and hang
+    every subsequent request).  Returns True when ``fut`` was actually
+    completed here."""
+    try:
+        if not fut.set_running_or_notify_cancel():
+            return False  # client cancelled while queued
+    except (RuntimeError, InvalidStateError):
+        return False  # already completed (e.g. the error path revisiting)
+    if isinstance(out, BaseException):
+        fut.set_exception(out)
+    else:
+        fut.set_result(out)
+    return True
+
+
+class _Join:
+    """Reassembles an oversize request that was split into chunks at
+    submit: chunk outputs land by index (the single dispatcher thread
+    completes them in FIFO order, but indexing is order-free anyway)
+    and the logical future resolves once — with the concatenated rows —
+    when the last chunk arrives."""
+
+    def __init__(self, future: Future, nparts: int, t_submit: float,
+                 metrics: ServingMetrics):
+        self.future = future
+        self.parts: list = [None] * nparts
+        self.missing = nparts
+        self.t_submit = t_submit
+        self.metrics = metrics
+        self.lock = threading.Lock()
+
+    def part(self, i: int) -> Callable:
+        def on_done(out, now: float) -> bool:
+            return self._complete(i, out, now)
+        return on_done
+
+    def _complete(self, i: int, out, now: float) -> bool:
+        """Returns True iff THIS call completed the logical future —
+        the error path counts failed logical requests from it, so a
+        split request failing across several packed batches is counted
+        once, matching the population every other metric uses."""
+        with self.lock:
+            if self.future.done():
+                return False
+            if isinstance(out, BaseException):
+                return _resolve_future(self.future, out)
+            self.parts[i] = out
+            self.missing -= 1
+            if self.missing:
+                return False
+        if _resolve_future(self.future,
+                           np.concatenate(self.parts, axis=0)):
+            self.metrics.record_request(now - self.t_submit)
+            return True
+        return False
+
+
+class ServingEngine:
+    """Inference engine over a compiled+initialized :class:`FFModel`.
+
+    ::
+
+        engine = ServingEngine(model)          # AOT-compiles all buckets
+        with engine:                           # starts the dispatcher
+            fut = engine.submit(x_rows)        # (n, ...) rows, n >= 1
+            y = fut.result()                   # (n, num_classes)
+
+    Knobs resolve from ``model.config`` (CLI ``--serve-max-batch``,
+    ``--serve-max-wait-ms``, ``--serve-buckets``) unless overridden by
+    constructor arguments; ``clock`` is injectable for deterministic
+    tests."""
+
+    def __init__(self, model, max_batch: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None,
+                 buckets: Optional[str] = None, stats_every: int = 64,
+                 metrics_window_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic):
+        assert model._compiled, "compile() + init_layers() the model first"
+        # persistent compile cache: bucket warmup below is exactly the
+        # compile-once-at-startup cost the cache makes warm across
+        # process restarts (idempotent; defers to a harness-picked dir)
+        _enable_compile_cache()
+        cfg = model.config
+        self.model = model
+        self.max_batch = int(max_batch or cfg.serve_max_batch
+                             or cfg.batch_size)
+        self.max_wait_ms = float(
+            cfg.serve_max_wait_ms if max_wait_ms is None else max_wait_ms)
+        self.buckets: Tuple[int, ...] = derive_buckets(
+            self.max_batch, cfg.serve_buckets if buckets is None else buckets)
+        self.clock = clock
+        self.stats_every = int(stats_every)
+        self.metrics = ServingMetrics(window_s=metrics_window_s, clock=clock)
+        self._batcher = MicroBatcher(self.max_batch, self.max_wait_ms,
+                                     clock=clock)
+        self._n_inputs = len(model.input_tensors)
+        self._in_dtypes = [t.dtype for t in model.input_tensors]
+        self._in_shapes = [tuple(t.shape[1:]) for t in model.input_tensors]
+        # pay every bucket's AOT compile up front; the executables live
+        # in model._fwd_compiled (the same cache predict() uses, so a
+        # model re-compile() is followed, never served stale) — the
+        # engine deliberately keeps no snapshot of its own
+        for b in self.buckets:
+            model.forward_compiled(b)
+        self._thread: Optional[threading.Thread] = None
+        self._n_dispatch = 0
+        self._stopped = False
+        self._lifecycle = threading.Lock()
+
+    # ---- lifecycle -----------------------------------------------------
+    def start(self) -> "ServingEngine":
+        with self._lifecycle:
+            if self._stopped:
+                # the batcher closed irreversibly at stop(); a
+                # restarted dispatcher would exit instantly while
+                # submit() raised — fail loudly instead of appearing
+                # to serve
+                raise RuntimeError(
+                    "engine was stopped; create a new ServingEngine "
+                    "(the AOT bucket executables are cached on the "
+                    "model, so a fresh engine starts warm)")
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._dispatch_loop, name="ff-serve-dispatch",
+                    daemon=True)
+                self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Drain pending requests, stop the dispatcher, emit final
+        stats.  Idempotent and safe under concurrent callers — the
+        lifecycle lock serializes them, every stop() returns only once
+        the drain finished, and only the first emits the final
+        snapshot (the dispatcher thread never takes this lock, so
+        holding it across the join cannot deadlock).  The engine is
+        single-use — see start()."""
+        with self._lifecycle:
+            self._stopped = True
+            self._batcher.close()
+            if self._thread is not None:
+                self._thread.join()
+                self._thread = None
+                self.metrics.emit(extra={"final": True,
+                                         "max_batch": self.max_batch})
+            else:
+                # never started: there is no dispatcher to drain the
+                # queue, so fail any futures queued before stop() —
+                # leaving them pending would block result() forever
+                now = self.clock()
+                err = RuntimeError(
+                    "engine stopped before it was started")
+                while True:
+                    reqs = self._batcher.poll()
+                    if not reqs:
+                        break
+                    for r in reqs:
+                        r.on_done(err, now)
+
+    def __enter__(self) -> "ServingEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ---- producer side -------------------------------------------------
+    def submit(self, *xs) -> Future:
+        """Queue one inference request of ``n`` rows (each positional
+        arg is one model input, leading dim ``n``) and return a Future
+        resolving to the ``(n, ...)`` output rows.  Thread-safe.
+        Requests larger than ``max_batch`` are split into chunks and
+        transparently reassembled."""
+        if len(xs) != self._n_inputs:
+            raise ValueError(f"model has {self._n_inputs} input(s), got "
+                             f"{len(xs)}")
+        # copy=True: submit() returns immediately while the rows sit in
+        # the queue up to max_wait_ms (longer under load) — a caller
+        # reusing its buffer must not mutate an in-flight request, so
+        # the engine owns its copy from the moment submit() returns
+        arrs = tuple(np.array(a, dtype=d, copy=True)
+                     for a, d in zip(xs, self._in_dtypes))
+        if any(a.ndim == 0 for a in arrs):
+            raise ValueError("request inputs must have a leading row "
+                             "dimension (shape (n, ...))")
+        n = int(arrs[0].shape[0])
+        if n < 1:
+            raise ValueError("empty request (0 rows)")
+        if any(a.shape[0] != n for a in arrs):
+            raise ValueError(f"inputs disagree on row count: "
+                             f"{[a.shape[0] for a in arrs]}")
+        for a, want in zip(arrs, self._in_shapes):
+            # reject the malformed request HERE: packed into a batch,
+            # its bad trailing shape would fail the whole dispatch and
+            # poison every innocent request coalesced with it
+            if tuple(a.shape[1:]) != want:
+                raise ValueError(
+                    f"request rows shaped {tuple(a.shape[1:])} do not "
+                    f"match the model input {want}")
+        fut: Future = Future()
+        t0 = self.clock()
+        sizes = split_sizes(n, self.max_batch)
+        if len(sizes) == 1:
+            metrics = self.metrics
+
+            def on_done(out, now: float) -> bool:
+                if isinstance(out, BaseException):
+                    return _resolve_future(fut, out)
+                if _resolve_future(fut, out):
+                    metrics.record_request(now - t0)
+                    return True
+                return False
+
+            self._batcher.submit(Request(arrs, n, on_done, t0))
+        else:
+            join = _Join(fut, len(sizes), t0, self.metrics)
+            chunks = []
+            off = 0
+            for i, sz in enumerate(sizes):
+                chunk = tuple(a[off:off + sz] for a in arrs)
+                chunks.append(Request(chunk, sz, join.part(i), t0))
+                off += sz
+            # atomic: all chunks or none (a concurrent stop() must not
+            # strand already-queued chunks of a request whose submit
+            # raised)
+            self._batcher.submit_all(chunks)
+        return fut
+
+    def stats(self) -> Dict:
+        """Rolling metrics snapshot plus engine shape (pull-side
+        counterpart of the periodic ``serve_stats`` events)."""
+        return {**self.metrics.snapshot(), "max_batch": self.max_batch,
+                "max_wait_ms": self.max_wait_ms,
+                "buckets": list(self.buckets)}
+
+    # ---- dispatcher thread ---------------------------------------------
+    def _dispatch_loop(self) -> None:
+        while True:
+            reqs = self._batcher.next_batch()
+            if reqs is None:
+                return  # closed and drained
+            try:
+                self._dispatch_batch(reqs)
+            except BaseException as e:  # noqa: BLE001 — one poisoned
+                # batch must fail ITS futures, not kill the dispatcher.
+                # on_done reports whether it completed the LOGICAL
+                # request, so split chunks count their request once
+                now = self.clock()
+                failed = sum(1 for r in reqs if r.on_done(e, now))
+                self.metrics.record_errors(failed)
+
+    def _dispatch_batch(self, reqs) -> None:
+        import jax
+
+        model = self.model
+        rows = sum(r.n for r in reqs)
+        bucket = bucket_for(rows, self.buckets)
+        depth = self._batcher.queue_depth
+        t0 = self.clock()
+        packed = []
+        for j in range(self._n_inputs):
+            block = (reqs[0].xs[j] if len(reqs) == 1 else
+                     np.concatenate([r.xs[j] for r in reqs], axis=0))
+            packed.append(block)
+        if rows < bucket:
+            # the ONE zero-padding rule, shared with predict()'s tail
+            packed = list(model._pad_tail(packed, bucket))
+        batch = tuple(model._shard_infer_batch(
+            tuple(packed) + (model._dummy_label(bucket),)))
+        idx = self._n_dispatch
+        self._n_dispatch = idx + 1
+        # look the executable up through the MODEL's cache (a dict hit
+        # when warm), not the startup snapshot: a model re-compile()
+        # clears model._fwd_compiled, and dispatching a stale
+        # executable lowered from the old graph would silently diverge
+        # from predict()
+        fwd = model.forward_compiled(bucket)
+        with jax.profiler.StepTraceAnnotation("serve", step_num=idx):
+            out = fwd(model._params, batch)
+            # the ONE host fetch for the whole packed batch — per-request
+            # outputs are sliced from it below (RL005 bans any host sync
+            # inside the scatter loop)
+            host = np.asarray(jax.device_get(out))
+        now = self.clock()
+        self.metrics.record_dispatch(rows, bucket, len(reqs), depth,
+                                     now - t0)
+        off = 0
+        for r in reqs:
+            # copy, not a view: a view would keep the whole packed
+            # bucket buffer alive for as long as a client retains one
+            # request's rows
+            r.on_done(host[off:off + r.n].copy(), now)
+            off += r.n
+        if self.stats_every and self._n_dispatch % self.stats_every == 0:
+            self.metrics.emit(extra={"max_batch": self.max_batch})

@@ -1,0 +1,140 @@
+"""Serving observability: rolling QPS, batch occupancy, queue depth and
+latency percentiles, emitted as JSON events through the existing
+fflogger machinery (one ``serve_stats`` line per reporting interval —
+the same one-parseable-line-per-record contract as fit()'s ``epoch``
+events).
+
+Quantiles come from :func:`flexflow_tpu.profiling.quantiles`
+(nearest-rank — every reported p50/p95/p99 is a latency that actually
+happened).  All state is windowed/bounded: a week-long serving process
+must not grow its metrics memory with traffic.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict
+
+from ..fflogger import get_logger
+from ..profiling import quantiles
+
+
+class ServingMetrics:
+    """Thread-safe rolling serving statistics.
+
+    Dispatch-side records (`record_dispatch`) come from the dispatcher
+    thread, one per packed batch; request-side records
+    (`record_request`) fire when a logical request's future resolves.
+    `snapshot()` reduces the rolling window to the flat dict that both
+    the ``serve_stats`` JSON event and serve-bench report."""
+
+    def __init__(self, window_s: float = 30.0, max_latency_samples: int = 4096,
+                 clock: Callable[[], float] = time.monotonic):
+        self.window_s = float(window_s)
+        self.clock = clock
+        self._lock = threading.Lock()
+        # (t, rows, bucket, n_reqs, dispatch_s) per packed batch
+        self._dispatches: deque = deque()
+        # (t, latency_s) per completed logical request
+        self._latencies: deque = deque(maxlen=max_latency_samples)
+        self._queue_depth = 0
+        self.total_dispatches = 0
+        self.total_requests = 0
+        self.total_rows = 0
+        self.total_errors = 0
+
+    # ---- recording -----------------------------------------------------
+    def _trim(self, now: float) -> None:
+        horizon = now - self.window_s
+        while self._dispatches and self._dispatches[0][0] < horizon:
+            self._dispatches.popleft()
+        while self._latencies and self._latencies[0][0] < horizon:
+            self._latencies.popleft()
+
+    def record_dispatch(self, rows: int, bucket: int, n_reqs: int,
+                        queue_depth: int, dispatch_s: float) -> None:
+        now = self.clock()
+        with self._lock:
+            self._dispatches.append((now, rows, bucket, n_reqs, dispatch_s))
+            self._queue_depth = queue_depth
+            self.total_dispatches += 1
+            self.total_rows += rows
+            self._trim(now)
+
+    def record_request(self, latency_s: float) -> None:
+        now = self.clock()
+        with self._lock:
+            self._latencies.append((now, latency_s))
+            self.total_requests += 1
+
+    def record_errors(self, n_reqs: int) -> None:
+        """LOGICAL requests failed by the dispatch error path (split
+        chunks count their request once, like every other metric) —
+        without this a failure storm would read as an IDLE engine in
+        serve_stats (no dispatches, no requests) while clients get
+        exceptions."""
+        with self._lock:
+            self.total_errors += int(n_reqs)
+
+    # ---- reporting -----------------------------------------------------
+    def snapshot(self) -> Dict[str, float]:
+        """Flat rolling-window stats: ``qps`` (completed LOGICAL
+        requests over the window — same population as the latency
+        percentiles, so an oversize request split into chunks counts
+        once), ``rows_per_sec`` (dispatched rows over the window),
+        ``batch_occupancy`` (mean rows/bucket fill of dispatched
+        batches — 1.0 means every dispatch ran a full bucket),
+        ``queue_depth`` (at the last dispatch), ``dispatch_ms`` (mean
+        device dispatch+fetch wall time) and nearest-rank latency
+        percentiles in ms."""
+        now = self.clock()
+        with self._lock:
+            self._trim(now)
+            disp = list(self._dispatches)
+            lat_rows = list(self._latencies)
+            lats = [l for _, l in lat_rows]
+            depth = self._queue_depth
+            totals = (self.total_dispatches, self.total_requests,
+                      self.total_rows, self.total_errors)
+        span = self.window_s
+        if disp:
+            span = min(self.window_s, max(1e-6, now - disp[0][0]))
+        req_span = self.window_s
+        if lat_rows:
+            req_span = min(self.window_s,
+                           max(1e-6, now - lat_rows[0][0]))
+        rows = sum(d[1] for d in disp)
+        occ = (sum(d[1] / d[2] for d in disp) / len(disp)) if disp else 0.0
+        q = quantiles(lats)
+
+        def ms(v):
+            # None, not NaN: json.dumps writes bare `NaN` (invalid
+            # JSON) and would break the one-parseable-line contract
+            # for any strict consumer when the latency window is empty
+            return None if v != v else round(v * 1e3, 3)
+
+        return {
+            "qps": round(len(lats) / req_span, 3),
+            "rows_per_sec": round(rows / span, 3),
+            "batch_occupancy": round(occ, 4),
+            "queue_depth": depth,
+            "dispatch_ms": round(
+                sum(d[4] for d in disp) / len(disp) * 1e3, 3) if disp
+                else 0.0,
+            "p50_ms": ms(q[0.5]),
+            "p95_ms": ms(q[0.95]),
+            "p99_ms": ms(q[0.99]),
+            "dispatches": totals[0],
+            "requests": totals[1],
+            "rows": totals[2],
+            "errors": totals[3],
+        }
+
+    def emit(self, extra: Dict | None = None) -> None:
+        """One ``serve_stats`` JSON event line on the ``serve`` logger
+        (fflogger.Category.event) — the serving analogue of fit()'s
+        per-epoch event."""
+        get_logger("serve").event("serve_stats", **self.snapshot(),
+                                  **(extra or {}))

@@ -26,6 +26,15 @@ by tier-1 ``tests/test_static_checks.py``).  Rules:
   batch (ISSUE 4's fused-dispatch fix: accumulate on device, fetch
   ONCE after the loop).  The per-EPOCH loop (``for epoch in ...``) is
   exempt — an epoch-boundary fetch is the intended sync point.
+* **RL005 — no per-request host syncs in the serving dispatch path**
+  (the serve-side mirror of RL004, ISSUE 5): inside the dispatch
+  functions of ``flexflow_tpu/serving/`` (``_dispatch_loop`` /
+  ``_dispatch_batch``), the engine's contract is ONE ``device_get``
+  per packed batch, amortized over every coalesced request.  The
+  straight-line per-batch fetch is sanctioned (as is the ``while``
+  serve loop itself — the analogue of RL004's epoch loop); any
+  ``float``/``np.asarray``/``jax.device_get`` inside a ``for`` loop
+  there is a per-request sync and is rejected.
 
 Exit 0 when clean, 1 with ``file:line: RLxxx message`` findings on
 stdout.  No third-party deps — must run on a bare CPython.
@@ -69,6 +78,10 @@ def _rel(path: str) -> str:
 _RL004_BANNED = {"float", "np.asarray", "numpy.asarray", "jax.device_get",
                  "jax.block_until_ready"}
 _RL004_FUNCS = ("fit", "evaluate", "predict")
+# the serving dispatch functions RL005 scopes to (same banned set): the
+# engine fetches once per packed batch in straight-line code; for-loops
+# inside these iterate requests
+_RL005_FUNCS = ("_dispatch_loop", "_dispatch_batch")
 
 
 class _Visitor(ast.NodeVisitor):
@@ -81,8 +94,11 @@ class _Visitor(ast.NodeVisitor):
             relpath.startswith("flexflow_tpu/strategy/")
             or relpath == "flexflow_tpu/parallel/sharding.py")
         self.in_tests = relpath.startswith("tests/")
+        self.in_serving = relpath.startswith("flexflow_tpu/serving/")
         self._hot_func: Optional[str] = None  # inside fit/evaluate/predict
         self._batch_loops = 0                 # nested non-epoch loop depth
+        self._serve_func: Optional[str] = None  # inside _dispatch_*
+        self._req_loops = 0                   # nested for-loop depth there
 
     def _add(self, node: ast.AST, code: str, msg: str) -> None:
         self.findings.append((node.lineno, code, msg))
@@ -96,14 +112,19 @@ class _Visitor(ast.NodeVisitor):
             self._check_step_sync(node, name)
         self.generic_visit(node)
 
-    # --- RL004 scope tracking -----------------------------------------
+    # --- RL004/RL005 scope tracking -----------------------------------
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
         hot = (self.in_library and node.name in _RL004_FUNCS)
-        prev_f, prev_l = self._hot_func, self._batch_loops
+        serve = (self.in_serving and node.name in _RL005_FUNCS)
+        prev = (self._hot_func, self._batch_loops,
+                self._serve_func, self._req_loops)
         if hot:
             self._hot_func, self._batch_loops = node.name, 0
+        if serve:
+            self._serve_func, self._req_loops = node.name, 0
         self.generic_visit(node)
-        self._hot_func, self._batch_loops = prev_f, prev_l
+        (self._hot_func, self._batch_loops,
+         self._serve_func, self._req_loops) = prev
 
     visit_AsyncFunctionDef = visit_FunctionDef
 
@@ -113,6 +134,12 @@ class _Visitor(ast.NodeVisitor):
         target = getattr(node, "target", None)
         is_epoch = isinstance(target, ast.Name) and target.id == "epoch"
         scoped = self._hot_func is not None and not is_epoch
+        # RL005 scopes FOR loops only: in the dispatch functions they
+        # iterate requests, while the `while` serve loop is the
+        # sanctioned once-per-packed-batch granularity (the analogue of
+        # the epoch loop above)
+        serve_scoped = (self._serve_func is not None
+                        and isinstance(node, ast.For))
         # a For's iter expression runs ONCE per loop entry (e.g.
         # `for s in jax.device_get(sums):` is the once-after-the-loop
         # idiom) — scan it OUTSIDE the batch-loop scope
@@ -121,6 +148,8 @@ class _Visitor(ast.NodeVisitor):
             self.visit(node.iter)
         if scoped:
             self._batch_loops += 1
+        if serve_scoped:
+            self._req_loops += 1
         # a While's test RE-EVALUATES every iteration (`while
         # float(loss) > tol:` fences per iteration) — scan it INSIDE
         if isinstance(node, ast.While):
@@ -129,19 +158,27 @@ class _Visitor(ast.NodeVisitor):
             self.visit(stmt)
         if scoped:
             self._batch_loops -= 1
+        if serve_scoped:
+            self._req_loops -= 1
 
     visit_For = _visit_loop
     visit_While = _visit_loop
 
     def _check_step_sync(self, node: ast.Call, name: str) -> None:
-        if self._hot_func is None or self._batch_loops == 0:
+        if name not in _RL004_BANNED:
             return
-        if name in _RL004_BANNED:
+        if self._hot_func is not None and self._batch_loops > 0:
             self._add(node, "RL004",
                       f"{name}() inside the {self._hot_func}() batch loop "
                       f"fences the async dispatch pipeline every batch — "
                       f"keep sums/outputs on device and fetch once after "
                       f"the loop (docs/performance.md)")
+        if self._serve_func is not None and self._req_loops > 0:
+            self._add(node, "RL005",
+                      f"{name}() inside a {self._serve_func}() request "
+                      f"loop is a per-request host sync — fetch ONCE per "
+                      f"packed batch and scatter host slices "
+                      f"(docs/serving.md)")
 
     def _check_savez(self, node: ast.Call, name: str) -> None:
         if not self.in_library or self.is_resilience:

@@ -1,0 +1,504 @@
+"""Serving engine (ISSUE 5): shape-bucketed AOT executables + dynamic
+micro-batching.
+
+The parity suite pins BIT-IDENTICAL outputs between the engine and
+``predict()`` for mixed request sizes across buckets — packing a
+request with different neighbors (or padding it into a different
+bucket) must never change its bits — on single-device and the n=8 CPU
+mesh.  Plus: bucket-selection boundaries and oversize splits,
+deadline-flush behavior on a fake clock, a multi-thread submission
+smoke test, serving metrics/percentiles, compile-cache idempotence and
+the serve-bench smoke test.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.parallel.mesh import MachineMesh
+from flexflow_tpu.serving import (MicroBatcher, Request, ServingEngine,
+                                  ServingMetrics, bucket_for, derive_buckets,
+                                  split_sizes)
+
+BS = 16
+NFEAT = 12
+NCLS = 5
+
+
+def _model(mesh_shape=None, max_batch=BS):
+    cfg = ff.FFConfig(batch_size=BS, compute_dtype="float32")
+    cfg.serve_max_batch = max_batch
+    m = ff.FFModel(cfg, mesh=MachineMesh(mesh_shape or {"n": 1}))
+    x = m.create_tensor((BS, NFEAT), name="x")
+    t = m.dense(x, 24, activation="relu")
+    t = m.dense(t, NCLS)
+    m.compile(ff.SGDOptimizer(lr=0.1), metrics=["accuracy"])
+    m.init_layers(seed=0)
+    return m
+
+
+def _requests(sizes, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.standard_normal((s, NFEAT)).astype(np.float32)
+            for s in sizes]
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ----------------------------------------------------------------------
+# bucket selection / oversize splits (pure functions)
+# ----------------------------------------------------------------------
+def test_derive_buckets_powers_of_two():
+    assert derive_buckets(64) == (2, 4, 8, 16, 32, 64)
+    # non-power-of-two max is always its own (largest) bucket
+    assert derive_buckets(48) == (2, 4, 8, 16, 32, 48)
+    assert derive_buckets(2) == (2,)
+    assert derive_buckets(1) == (1,)
+    assert derive_buckets(64, "2,16,64") == (2, 16, 64)
+    # max_batch joins an explicit list that omits it
+    assert derive_buckets(64, "4,16") == (4, 16, 64)
+    with pytest.raises(ValueError, match="exceeds max_batch"):
+        derive_buckets(16, "4,32")
+    with pytest.raises(ValueError, match="bad bucket spec"):
+        derive_buckets(16, "a,b")
+    with pytest.raises(ValueError, match="max_batch"):
+        derive_buckets(0)
+
+
+def test_bucket_for_exact_boundaries():
+    buckets = derive_buckets(64)
+    assert bucket_for(1, buckets) == 2
+    assert bucket_for(2, buckets) == 2   # exact boundary -> own bucket
+    assert bucket_for(3, buckets) == 4
+    assert bucket_for(4, buckets) == 4
+    assert bucket_for(5, buckets) == 8
+    assert bucket_for(33, buckets) == 64
+    assert bucket_for(64, buckets) == 64
+    assert bucket_for(65, buckets) is None  # oversize: caller splits
+
+
+def test_split_sizes_oversize_requests():
+    assert split_sizes(5, 32) == [5]
+    assert split_sizes(32, 32) == [32]
+    assert split_sizes(70, 32) == [32, 32, 6]
+    assert split_sizes(64, 32) == [32, 32]
+    assert sum(split_sizes(1000, 48)) == 1000
+
+
+# ----------------------------------------------------------------------
+# deadline flush (fake clock, no threads)
+# ----------------------------------------------------------------------
+def _req(n, clock, done):
+    return Request((np.zeros((n, 1), np.float32),), n,
+                   lambda out, now: done.append((n, out)), clock())
+
+
+def test_deadline_flush_fake_clock():
+    clk = FakeClock()
+    b = MicroBatcher(max_batch=8, max_wait_ms=5.0, clock=clk)
+    done = []
+    b.submit(_req(3, clk, done))
+    assert b.poll() is None          # not full, deadline not reached
+    clk.t = 0.0049
+    assert b.poll() is None          # 4.9ms < 5ms: still coalescing
+    clk.t = 0.0051
+    batch = b.poll()                 # deadline passed: flush partial
+    assert batch is not None and [r.n for r in batch] == [3]
+    assert b.poll() is None          # queue drained
+
+
+def test_full_batch_flushes_without_deadline():
+    clk = FakeClock()
+    b = MicroBatcher(max_batch=8, max_wait_ms=1e9, clock=clk)
+    done = []
+    b.submit(_req(5, clk, done))
+    assert b.poll() is None
+    b.submit(_req(3, clk, done))     # 5+3 == max_batch: due NOW
+    batch = b.poll()
+    assert [r.n for r in batch] == [5, 3]
+
+
+def test_batcher_fifo_prefix_and_close_drain():
+    clk = FakeClock()
+    b = MicroBatcher(max_batch=8, max_wait_ms=1e9, clock=clk)
+    done = []
+    for n in (4, 3, 6):
+        b.submit(_req(n, clk, done))
+    assert b.pending_rows == 13 and b.queue_depth == 3
+    b.close()                        # drain mode: everything is due
+    assert [r.n for r in b.poll()] == [4, 3]  # 4+3 fits, +6 would not
+    assert [r.n for r in b.poll()] == [6]
+    assert b.next_batch() is None    # closed AND drained
+    with pytest.raises(RuntimeError, match="closed"):
+        b.submit(_req(1, clk, done))
+
+
+def test_batcher_rejects_oversize_request():
+    b = MicroBatcher(max_batch=4, max_wait_ms=1.0)
+    with pytest.raises(ValueError, match="split first"):
+        b.submit(Request((np.zeros((5, 1)),), 5, lambda o, t: None, 0.0))
+
+
+def test_submit_all_atomic_after_close():
+    """Split-request chunks enqueue all-or-nothing: after close() the
+    whole group is rejected and NOTHING is queued (a half-enqueued
+    oversize request would drain orphan chunks nobody waits on)."""
+    clk = FakeClock()
+    b = MicroBatcher(max_batch=4, max_wait_ms=1.0, clock=clk)
+    b.close()
+    chunks = [Request((np.zeros((2, 1)),), 2, lambda o, t: None, 0.0)
+              for _ in range(3)]
+    with pytest.raises(RuntimeError, match="closed"):
+        b.submit_all(chunks)
+    assert b.queue_depth == 0 and b.pending_rows == 0
+
+
+# ----------------------------------------------------------------------
+# engine <-> predict parity: bit-identical, mixed sizes, both meshes
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("mesh_shape", [{"n": 1}, {"n": 8}],
+                         ids=["single", "distributed"])
+def test_engine_predict_parity_bitwise(mesh_shape):
+    m = _model(mesh_shape)
+    # mixed sizes hit every bucket, exact boundaries (2/4/8/16), the
+    # deadline-flush partial path, and the oversize split (40 > 16)
+    sizes = [1, 3, 4, 7, 16, 5, 2, 40, 8, 1, 6, 16]
+    reqs = _requests(sizes)
+    eng = ServingEngine(m, stats_every=0)
+    # AOT-warm at startup, in the cache predict() shares
+    assert set(eng.buckets) <= set(m._fwd_compiled)
+    with eng:
+        futs = [eng.submit(r) for r in reqs]
+        outs = [f.result(timeout=60) for f in futs]
+    want = m.predict(np.concatenate(reqs), batch_size=BS)
+    # results own their memory — a view would pin the whole packed
+    # bucket buffer for as long as a client keeps one request's rows
+    assert all(o.base is None for o in outs)
+    off = 0
+    for s, o in zip(sizes, outs):
+        assert o.shape == (s, NCLS)
+        np.testing.assert_array_equal(o, want[off:off + s],
+                                      err_msg=f"request of {s} rows")
+        off += s
+    snap = eng.stats()
+    assert snap["requests"] == len(sizes)
+    assert snap["rows"] == sum(sizes)
+    assert snap["dispatches"] >= 1
+
+
+def test_engine_multi_input_model_parity():
+    cfg = ff.FFConfig(batch_size=BS, compute_dtype="float32")
+    cfg.serve_max_batch = BS
+    m = ff.FFModel(cfg, mesh=MachineMesh({"n": 1}))
+    a = m.create_tensor((BS, 6), name="a")
+    b = m.create_tensor((BS, 6), name="b")
+    t = m.concat([a, b], axis=1)
+    t = m.dense(t, 16, activation="relu")
+    m.dense(t, NCLS)
+    m.compile(ff.SGDOptimizer(lr=0.1), metrics=["accuracy"])
+    m.init_layers(seed=0)
+    rng = np.random.default_rng(1)
+    sizes = [2, 5, 9, 16, 3]
+    xa = [rng.standard_normal((s, 6)).astype(np.float32) for s in sizes]
+    xb = [rng.standard_normal((s, 6)).astype(np.float32) for s in sizes]
+    with ServingEngine(m, stats_every=0) as eng:
+        outs = [f.result(timeout=60)
+                for f in [eng.submit(p, q) for p, q in zip(xa, xb)]]
+    want = m.predict([np.concatenate(xa), np.concatenate(xb)],
+                     batch_size=BS)
+    off = 0
+    for s, o in zip(sizes, outs):
+        np.testing.assert_array_equal(o, want[off:off + s])
+        off += s
+
+
+def test_cancelled_future_does_not_kill_dispatcher():
+    """A client cancel() on a queued future (the standard move after a
+    result(timeout=...) TimeoutError) must be dropped by the scatter —
+    not raise InvalidStateError on the dispatcher thread, which would
+    hang every subsequent request."""
+    m = _model()
+    reqs = _requests([3, 4, 5], seed=11)
+    eng = ServingEngine(m, stats_every=0)
+    # cancel while queued: submit before the dispatcher thread starts
+    doomed = eng.submit(reqs[0])
+    assert doomed.cancel()
+    keep = [eng.submit(r) for r in reqs[1:]]
+    eng.start()
+    outs = [f.result(timeout=30) for f in keep]
+    # the engine must still serve AFTER the cancelled dispatch too
+    after = eng.submit(reqs[0]).result(timeout=30)
+    eng.stop()
+    want = m.predict(np.concatenate(reqs[1:]), batch_size=BS)
+    off = 0
+    for r, o in zip(reqs[1:], outs):
+        np.testing.assert_array_equal(o, want[off:off + len(r)])
+        off += len(r)
+    np.testing.assert_array_equal(
+        after, m.predict(reqs[0], batch_size=BS)[:len(reqs[0])])
+    assert doomed.cancelled()
+
+
+def test_submit_copies_caller_buffer():
+    """submit() returns while the rows are still queued — the engine
+    must own a copy so a client reusing its buffer cannot mutate an
+    in-flight request."""
+    m = _model()
+    eng = ServingEngine(m, stats_every=0)
+    buf = np.ones((3, NFEAT), np.float32)
+    want = m.predict(buf.copy(), batch_size=BS)[:3]
+    fut = eng.submit(buf)      # queued; dispatcher not started yet
+    buf[:] = -7.0              # client reuses its buffer immediately
+    eng.start()
+    np.testing.assert_array_equal(fut.result(timeout=30), want)
+    eng.stop()
+
+
+def test_submit_validation():
+    m = _model()
+    with ServingEngine(m, stats_every=0) as eng:
+        with pytest.raises(ValueError, match="input"):
+            eng.submit(np.zeros((2, NFEAT), np.float32),
+                       np.zeros((2, NFEAT), np.float32))
+        with pytest.raises(ValueError, match="empty"):
+            eng.submit(np.zeros((0, NFEAT), np.float32))
+        # a malformed trailing shape is rejected at submit() — packed
+        # into a batch it would poison every coalesced neighbor
+        with pytest.raises(ValueError, match="do not match"):
+            eng.submit(np.zeros((2, NFEAT + 1), np.float32))
+        # ...and valid traffic around the rejection still serves
+        ok = eng.submit(np.ones((3, NFEAT), np.float32)).result(timeout=30)
+        assert ok.shape == (3, NCLS)
+    assert eng.stats()["errors"] == 0
+
+
+# ----------------------------------------------------------------------
+# concurrency smoke: N threads submitting, no interleaving corruption
+# ----------------------------------------------------------------------
+def test_concurrent_submitters_resolve_correctly():
+    m = _model()
+    nthreads, per_thread = 6, 12
+    rng = np.random.default_rng(7)
+    inputs = {t: [rng.standard_normal((int(s), NFEAT)).astype(np.float32)
+                  for s in rng.integers(1, 9, per_thread)]
+              for t in range(nthreads)}
+    expected = {t: m.predict(np.concatenate(inputs[t]), batch_size=BS)
+                for t in range(nthreads)}
+    results = {}
+    with ServingEngine(m, stats_every=0) as eng:
+        def worker(t):
+            futs = [eng.submit(x) for x in inputs[t]]
+            results[t] = [f.result(timeout=60) for f in futs]
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(nthreads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=60)
+            assert not th.is_alive()
+    for t in range(nthreads):
+        off = 0
+        for x, o in zip(inputs[t], results[t]):
+            np.testing.assert_array_equal(
+                o, expected[t][off:off + len(x)],
+                err_msg=f"thread {t} request at row {off}")
+            off += len(x)
+
+
+# ----------------------------------------------------------------------
+# AOT executables: startup warm, cache reuse, predict reroute
+# ----------------------------------------------------------------------
+def test_forward_compiled_cached_and_shared_with_predict():
+    m = _model()
+    c8 = m.forward_compiled(8)
+    assert m.forward_compiled(8) is c8            # cached per bucket
+    x = np.zeros((10, NFEAT), np.float32)
+    m.predict(x, batch_size=4)
+    assert 4 in m._fwd_compiled                   # predict shares the cache
+    assert 4 in m._dummy_labels                   # label feed cached per bs
+    with pytest.raises(ValueError, match="bucket batch size"):
+        m.forward_compiled(0)
+
+
+def test_predict_coerces_input_dtype():
+    """The old per-call jit silently retraced for an int feed to a
+    float-declared input; the AOT reroute must keep that working by
+    casting to the declared dtype up front."""
+    m = _model()
+    x = np.arange(5 * NFEAT, dtype=np.int32).reshape(5, NFEAT)
+    out = m.predict(x, batch_size=4)
+    want = m.predict(x.astype(np.float32), batch_size=4)
+    np.testing.assert_array_equal(out, want)
+
+
+def test_predict_unchanged_by_reroute():
+    m = _model()
+    x = np.asarray(_requests([2 * BS + 3], seed=3)[0])
+    full = m.predict(x, batch_size=BS)            # exact + padded tail
+    again = m.predict(x, batch_size=2 * BS + 3)   # one exact batch
+    np.testing.assert_array_equal(full, again)
+    exact = m.predict(x[:2 * BS], batch_size=BS)  # n % bs == 0: no pad
+    np.testing.assert_array_equal(exact, full[:2 * BS])
+
+
+# ----------------------------------------------------------------------
+# metrics: rolling window, nearest-rank percentiles, JSON events
+# ----------------------------------------------------------------------
+def test_quantiles_nearest_rank():
+    from flexflow_tpu.profiling import quantiles
+    q = quantiles([ms / 1e3 for ms in range(1, 101)])
+    assert q[0.5] == pytest.approx(0.050)
+    assert q[0.95] == pytest.approx(0.095)
+    assert q[0.99] == pytest.approx(0.099)
+    assert all(np.isnan(v) for v in quantiles([]).values())
+    assert quantiles([0.7])[0.99] == pytest.approx(0.7)
+
+
+def test_serving_metrics_snapshot():
+    clk = FakeClock()
+    sm = ServingMetrics(window_s=100.0, clock=clk)
+    for ms in range(1, 101):
+        sm.record_request(ms / 1e3)
+    sm.record_dispatch(rows=12, bucket=16, n_reqs=3, queue_depth=2,
+                       dispatch_s=0.004)
+    sm.record_dispatch(rows=16, bucket=16, n_reqs=4, queue_depth=0,
+                       dispatch_s=0.002)
+    clk.t = 10.0
+    snap = sm.snapshot()
+    assert snap["p50_ms"] == pytest.approx(50.0)
+    assert snap["p95_ms"] == pytest.approx(95.0)
+    assert snap["p99_ms"] == pytest.approx(99.0)
+    # qps counts LOGICAL requests (the latency population), not chunks
+    assert snap["qps"] == pytest.approx(10.0)         # 100 reqs / 10s
+    assert snap["rows_per_sec"] == pytest.approx(2.8)
+    assert snap["batch_occupancy"] == pytest.approx((12 / 16 + 1.0) / 2)
+    assert snap["queue_depth"] == 0
+    assert snap["dispatch_ms"] == pytest.approx(3.0)
+    assert snap["dispatches"] == 2 and snap["requests"] == 100
+
+
+def test_metrics_window_trims_old_samples():
+    import json as _json
+    clk = FakeClock()
+    sm = ServingMetrics(window_s=5.0, clock=clk)
+    sm.record_dispatch(rows=8, bucket=8, n_reqs=2, queue_depth=0,
+                       dispatch_s=0.001)
+    sm.record_request(0.003)
+    clk.t = 100.0  # far past the window
+    snap = sm.snapshot()
+    assert snap["qps"] == 0.0 and snap["batch_occupancy"] == 0.0
+    assert snap["dispatches"] == 1  # lifetime totals survive the trim
+    # empty latency window reports null, never NaN (bare NaN is not
+    # valid JSON and would break the one-parseable-line contract)
+    assert snap["p50_ms"] is None and snap["p99_ms"] is None
+    _json.loads(_json.dumps(snap))
+
+
+def test_stop_before_start_fails_queued_futures():
+    """stop() on a never-started engine has no dispatcher to drain the
+    queue — queued futures must fail loudly, not block forever."""
+    m = _model()
+    eng = ServingEngine(m, stats_every=0)
+    fut = eng.submit(np.zeros((2, NFEAT), np.float32))
+    eng.stop()
+    with pytest.raises(RuntimeError, match="before it was started"):
+        fut.result(timeout=5)
+
+
+def test_engine_single_use_lifecycle():
+    m = _model()
+    eng = ServingEngine(m, stats_every=0)
+    with eng:
+        eng.submit(np.zeros((2, NFEAT), np.float32)).result(timeout=30)
+    eng.stop()  # idempotent
+    with pytest.raises(RuntimeError, match="stopped"):
+        eng.start()
+    # a fresh engine on the same model starts warm (shared AOT cache)
+    eng2 = ServingEngine(m, stats_every=0)
+    with eng2:
+        eng2.submit(np.zeros((2, NFEAT), np.float32)).result(timeout=30)
+
+
+def test_predict_rejects_wrong_input_count():
+    m = _model()
+    with pytest.raises(ValueError, match="input"):
+        m.predict([np.zeros((4, NFEAT), np.float32),
+                   np.zeros((4, NFEAT), np.float32)])
+
+
+def test_engine_emits_serve_stats_events(capsys):
+    m = _model()
+    with ServingEngine(m, stats_every=1) as eng:
+        eng.submit(np.zeros((3, NFEAT), np.float32)).result(timeout=30)
+    events = [json.loads(line)
+              for line in capsys.readouterr().out.splitlines()
+              if line.startswith("{")]
+    stats = [e for e in events if e.get("event") == "serve_stats"]
+    assert stats, "no serve_stats event emitted"
+    for key in ("qps", "rows_per_sec", "batch_occupancy", "queue_depth",
+                "p50_ms", "p95_ms", "p99_ms", "dispatches"):
+        assert key in stats[-1], key
+    assert stats[-1]["final"] is True  # stop() emits the final snapshot
+
+
+# ----------------------------------------------------------------------
+# compile cache: FF_CACHE_DIR override + idempotence
+# ----------------------------------------------------------------------
+def test_compile_cache_enable_idempotent(monkeypatch):
+    import jax
+
+    from flexflow_tpu import compile_cache
+
+    current = jax.config.jax_compilation_cache_dir
+    assert current  # the test harness configured its session cache
+    compile_cache.enable()  # default call defers to the harness's dir
+    assert jax.config.jax_compilation_cache_dir == current
+    monkeypatch.setenv("FF_CACHE_DIR", current)
+    compile_cache.enable()  # explicit same-dir: no churn either
+    assert jax.config.jax_compilation_cache_dir == current
+
+
+def test_compile_cache_resolve_dir(monkeypatch):
+    from flexflow_tpu import compile_cache
+
+    monkeypatch.delenv("FF_CACHE_DIR", raising=False)
+    d, explicit = compile_cache._resolve_dir(None)
+    assert d == compile_cache.default_dir() and not explicit
+    d, explicit = compile_cache._resolve_dir("/tmp/somewhere")
+    assert d == "/tmp/somewhere" and explicit
+    monkeypatch.setenv("FF_CACHE_DIR", "/tmp/env-cache")
+    d, explicit = compile_cache._resolve_dir(None)
+    assert d == "/tmp/env-cache" and explicit
+    # an explicit argument outranks the env override
+    d, explicit = compile_cache._resolve_dir("/tmp/arg-cache")
+    assert d == "/tmp/arg-cache" and explicit
+
+
+# ----------------------------------------------------------------------
+# serve-bench smoke
+# ----------------------------------------------------------------------
+def test_serve_bench_smoke(tmp_path, capsys):
+    from flexflow_tpu.serving.bench import main as sb_main
+    out = tmp_path / "sb.json"
+    sb_main(["--requests", "24", "--max-batch", "8", "--rows", "1-4",
+             "--out", str(out)])
+    payload = json.loads(out.read_text())
+    assert payload["bench"] == "serve-bench"
+    assert payload["engine"]["qps_rows"] > 0
+    assert payload["naive"]["qps_rows"] > 0
+    assert payload["speedup_rows"] > 0
+    for phase in ("engine", "naive", "paced"):
+        for key in ("p50_ms", "p95_ms", "p99_ms"):
+            assert key in payload[phase], (phase, key)
+    assert payload["config"]["buckets"] == [2, 4, 8]
+    capsys.readouterr()  # drain the stdout JSON

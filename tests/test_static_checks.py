@@ -155,6 +155,42 @@ def test_static_checks_script_passes_on_repo():
      "        while float(self.loss) > 0.1:\n"
      "            self.step()\n",
      "RL004"),
+    # RL005: a host sync inside a per-REQUEST loop of the serving
+    # dispatch path fences once per request (ISSUE 5)
+    ("flexflow_tpu/serving/zz_bad_scatter.py",
+     "class E:\n"
+     "    def _dispatch_batch(self, reqs):\n"
+     "        out = self.run(reqs)\n"
+     "        for r in reqs:\n"
+     "            r.set_result(float(out))\n",
+     "RL005"),
+    # the sanctioned shape: ONE device_get per packed batch in
+    # straight-line code, host slices scattered in the loop
+    ("flexflow_tpu/serving/zz_ok_scatter.py",
+     "import jax\n\n"
+     "class E:\n"
+     "    def _dispatch_batch(self, reqs):\n"
+     "        host = jax.device_get(self.run(reqs))\n"
+     "        for r in reqs:\n"
+     "            r.set_result(host[r.i])\n",
+     None),
+    # the `while` serve loop is the per-batch granularity (the RL004
+    # epoch-loop analogue): a once-per-batch fetch there is fine
+    ("flexflow_tpu/serving/zz_ok_loop.py",
+     "import jax\n\n"
+     "class E:\n"
+     "    def _dispatch_loop(self):\n"
+     "        while self.running:\n"
+     "            host = jax.device_get(self.step())\n"
+     "            self.publish(host)\n",
+     None),
+    # outside flexflow_tpu/serving/ the rule does not engage
+    ("flexflow_tpu/zz_ok_not_serving.py",
+     "class E:\n"
+     "    def _dispatch_batch(self, reqs):\n"
+     "        for r in reqs:\n"
+     "            r.set_result(float(r.x))\n",
+     None),
 ])
 def test_repo_lint_rules(tmp_path, rel, src, code):
     """repo_lint unit check on synthetic files, laid out under tmp_path
