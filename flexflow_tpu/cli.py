@@ -62,8 +62,9 @@ def main(argv=None) -> None:
               "       flexflow-tpu lint --model NAME [--strategy s.pb] "
               "[--devices N] [--json]\n"
               "flags (reference model.cc:1221-1289): -e -b --lr --wd -d "
-              "--budget --alpha -s/-import -ll:tpu -ll:cpu --nodes "
-              "--profiling --seed --remat --steps-per-dispatch --pad-tail "
+              "--budget --alpha --reshard-budget -s/-import -ll:tpu "
+              "-ll:cpu --nodes --profiling --seed --remat "
+              "--steps-per-dispatch --pad-tail "
               "--serve-max-batch --serve-max-wait-ms --serve-buckets",
               file=sys.stderr)
         raise SystemExit(2)
@@ -220,6 +221,17 @@ def elastic_main(argv) -> int:
     parser.add_argument("--workdir", default=".",
                         help="checkpoint directory exported to workers "
                              "as FF_ELASTIC_WORKDIR")
+    parser.add_argument("--min-procs", type=int, default=None,
+                        help="degrade-and-continue floor: after "
+                             "--degrade-after consecutive crash/hang/"
+                             "timeout attempts, HALVE the group (not "
+                             "below this) and resume on the surviving "
+                             "mesh instead of retrying the dead "
+                             "topology (docs/elastic.md 'Resharding')")
+    parser.add_argument("--degrade-after", type=int, default=2,
+                        metavar="N",
+                        help="consecutive topology-class failures "
+                             "before a degrade step (default 2)")
     parser.add_argument("--backoff-base", type=float, default=0.5,
                         metavar="S")
     parser.add_argument("--backoff-max", type=float, default=30.0,
@@ -245,9 +257,11 @@ def elastic_main(argv) -> int:
         # initialize_distributed() picks up the JAX_* env below
         return [sys.executable, "-m", "flexflow_tpu.cli", *worker_cmd]
 
-    def per_rank_env(attempt, port, rank):
+    def per_rank_env(attempt, port, rank, nprocs):
+        # nprocs is the CURRENT world size — the degrade policy may have
+        # shrunk it below --nprocs; workers reshard on resume
         return {"JAX_COORDINATOR_ADDRESS": f"localhost:{port}",
-                "JAX_NUM_PROCESSES": str(args.nprocs),
+                "JAX_NUM_PROCESSES": str(nprocs),
                 "JAX_PROCESS_ID": str(rank)}
 
     report = run_elastic(
@@ -258,13 +272,15 @@ def elastic_main(argv) -> int:
         env={"FF_ELASTIC_WORKDIR": os.path.abspath(args.workdir)},
         per_rank_env=per_rank_env,
         backoff_base_s=args.backoff_base, backoff_max_s=args.backoff_max,
-        backoff_seed=args.backoff_seed)
+        backoff_seed=args.backoff_seed,
+        min_processes=args.min_procs, degrade_after=args.degrade_after)
     for i, a in enumerate(report.attempts):
         steps = (" steps=" + ",".join(
             f"r{r}:{s}" for r, s in sorted(a.rank_steps.items()))
             if a.rank_steps else "")
         detail = f" ({a.spawn_error})" if a.spawn_error else ""
         print(f"elastic attempt {i}: cause={a.cause} "
+              f"nprocs={a.num_processes} "
               f"rc={a.returncodes} elapsed={a.elapsed_s}s"
               f"{steps}{detail}", file=sys.stderr)
         if a.cause != "ok" and a.failed_rank is not None:

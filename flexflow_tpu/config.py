@@ -124,6 +124,14 @@ class FFConfig:
     search_alpha: float = 0.05  # --alpha: annealing temperature
     search_chains: int = 1      # --chains: independent MCMC chains
     search_overlap_backward_update: bool = False
+    # --reshard-budget: MCMC iterations for the IN-THE-LOOP re-search an
+    # elastic reshard point runs (FFModel.reshard / reshard-on-resume,
+    # docs/elastic.md "Resharding").  None = reuse search_budget; the
+    # delta-sim SimSession makes even the full budget cheap, but a
+    # reshard pause is latency the training loop feels, so this can be
+    # dialed down independently.  0 disables re-search at reshard points
+    # (strategies rescale onto the new mesh's data axis instead).
+    reshard_search_budget: Optional[int] = None
     import_strategy_file: str = ""
     export_strategy_file: str = ""
     # TPU-native additions
@@ -264,6 +272,8 @@ class FFConfig:
                 cfg.search_alpha = float(val())
             elif a == "--chains":
                 cfg.search_chains = max(1, int(val()))
+            elif a == "--reshard-budget":
+                cfg.reshard_search_budget = int(val())
             elif a == "--overlap":
                 cfg.search_overlap_backward_update = True
             elif a in ("-s", "--export"):

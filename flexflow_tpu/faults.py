@@ -25,11 +25,19 @@ Grammar (specs joined by ``;``, qualifiers by ``,``)::
     corrupt_ckpt:latest   truncate every checkpoint this process publishes
     spawn_fail_attempt:A  supervisor-side: fail attempt A at spawn time
     slow_rank:R           rank R sleeps ``delay`` (default 0.25 s) per step
+    grow_at_step:N        request an in-process mesh GROW after step N
+    shrink_at_step:N      request an in-process mesh SHRINK after step N
+                          (both consumed by the train loop via
+                          :func:`reshard_at_window` — FFModel.reshard();
+                          same window-edge rounding as kill/hang; target
+                          device count via ``devices=D``, default 2x /
+                          half the current mesh)
 
     qualifiers: rank=R (fire only on rank R), attempt=A or attempt=*
                 (default attempt=0 — faults must not re-fire on the
                 restarted attempt or recovery could never be observed),
-                delay=SECONDS (slow_rank), exit=CODE (kill_at_step)
+                delay=SECONDS (slow_rank), exit=CODE (kill_at_step),
+                devices=D (grow_at_step/shrink_at_step target)
 
 Examples::
 
@@ -61,7 +69,8 @@ from typing import Dict, List, Optional
 KILL_EXIT_CODE = 17
 
 KINDS = ("kill_at_step", "hang_at_step", "corrupt_ckpt",
-         "spawn_fail_attempt", "slow_rank")
+         "spawn_fail_attempt", "slow_rank", "grow_at_step",
+         "shrink_at_step")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -109,11 +118,15 @@ def parse_faults(text: Optional[str]) -> List[FaultSpec]:
                 rank = int(val)
             elif key == "attempt":
                 attempt = None if val == "*" else int(val)
-            elif key in ("delay", "exit"):
+            elif key in ("delay", "exit", "devices"):
                 # validate now, fail at parse not at fire — with the
                 # type actually used at fire time (exit=9.5 must not
                 # blow up inside the train loop)
                 (float if key == "delay" else int)(val)
+                if key == "devices" and int(val) < 1:
+                    raise ValueError(
+                        f"devices qualifier must be >= 1, got {val!r} "
+                        f"in {raw!r}")
                 extras[key] = val
             else:
                 raise ValueError(
@@ -235,20 +248,57 @@ def on_window(start: int, end: int) -> None:
                 time.sleep(float(spec.extras.get("delay", "0.25"))
                            * max(1, end - start))
         elif spec.kind == "hang_at_step" and start < int(spec.arg) <= end:
-            _note(f"injected hang at step {end}"
-                  + (f" (requested step {spec.arg} rounded up to the "
-                     f"window edge)" if int(spec.arg) != end else "")
-                  + f" (rank {current_rank()}, attempt {current_attempt()})")
+            _note(_edge_note("hang", spec, end))
             while True:  # no progress, no exit: only heartbeat monitoring
                 time.sleep(3600)  # (or the attempt timeout) can end this
         elif spec.kind == "kill_at_step" and start < int(spec.arg) <= end:
             code = int(spec.extras.get("exit", str(KILL_EXIT_CODE)))
-            _note(f"injected kill at step {end}"
-                  + (f" (requested step {spec.arg} rounded up to the "
-                     f"window edge)" if int(spec.arg) != end else "")
-                  + f" (rank {current_rank()}, attempt {current_attempt()}, "
-                  f"exit {code})")
+            _note(_edge_note("kill", spec, end, f"exit {code}"))
             os._exit(code)  # hard crash: no cleanup, no excepthook
+
+
+def _edge_note(what: str, spec, end: int, extra: str = "") -> str:
+    """One message format for every window-edge fire point (kill / hang
+    / grow / shrink): what fired, where it rounded from, and the
+    rank/attempt scope — kept in one place so the fire-point log the
+    fault matrix greps stays consistent across kinds."""
+    rounded = (f" (requested step {spec.arg} rounded up to the "
+               f"window edge)" if int(spec.arg) != end else "")
+    scope = f"rank {current_rank()}, attempt {current_attempt()}"
+    if extra:
+        scope += f", {extra}"
+    return f"injected {what} at step {end}{rounded} ({scope})"
+
+
+def reshard_at_window(start: int, end: int):
+    """Train-loop hook for the elastic-reshard fault kinds: which
+    ``grow_at_step:N`` / ``shrink_at_step:N`` specs fall inside the
+    just-completed window ``(start, end]``?  Returns a list of
+    ``(kind, devices)`` requests in spec order (EVERY matching spec —
+    a wide dispatch window may cover two scheduled reshards, and
+    dropping the second would silently change the injected plan);
+    ``devices`` is the ``devices=D`` qualifier as an int, or None for
+    the default scaling (grow doubles, shrink halves the mesh).  Same
+    window-edge rounding as kill/hang (a mid-window step index fires
+    at the dispatch boundary), and each spec fires at most once: only
+    the window CONTAINING its step matches.  The consumer is
+    ``FFModel.train_batch``/``train_window``, which performs the
+    actual :meth:`FFModel.reshard`; this module stays jax-free."""
+    p = plan()
+    if not p:
+        return []
+    out = []
+    for spec in p:
+        if spec.kind not in ("grow_at_step", "shrink_at_step"):
+            continue
+        if not _matches(spec):
+            continue
+        if start < int(spec.arg) <= end:
+            devices = spec.extras.get("devices")
+            _note(_edge_note(f"{spec.kind.split('_')[0]} reshard", spec,
+                             end, f"devices={devices if devices else 'auto'}"))
+            out.append((spec.kind, int(devices) if devices else None))
+    return out
 
 
 def corrupt_file(path: str) -> None:

@@ -963,6 +963,39 @@ class FFModel:
     # ------------------------------------------------------------------
     # init / weights access
     # ------------------------------------------------------------------
+    def _placed_param(self, p, val):
+        """Place one full (host- or device-resident) parameter value
+        under its resolved sharding for the CURRENT mesh — host
+        placement, strategy sharding, or replication.  The one placement
+        spelling shared by :meth:`init_layers` and :meth:`reshard` (the
+        latter re-places live training state after a mesh change)."""
+        if p.name in getattr(self, "_host_shardings", {}):
+            return jax.device_put(val, self._host_shardings[p.name])
+        if self.mesh is not None and self.mesh.is_distributed:
+            pc = None
+            for lop in self.layers:
+                if p in lop.weights:
+                    pc = lop.parallel_config
+                    break
+            spec = param_spec(p, pc, self.mesh)
+            return self._put_global(val, self.mesh.sharding(spec))
+        return jnp.asarray(val)
+
+    def _trainable_on_device(self, params: Dict[str, jax.Array]
+                             ) -> Dict[str, jax.Array]:
+        """The trainable subset of ``params`` with host-placed entries
+        re-pinned to their device shardings (optimizer slots live in
+        device memory even for host params) — the pytree optimizer
+        state is built from/around."""
+        trainable = {}
+        for k, v in params.items():
+            if k not in self._split_params():
+                continue
+            if k in getattr(self, "_host_shardings", {}):
+                v = jax.device_put(v, self._dev_shardings[k])
+            trainable[k] = v
+        return trainable
+
     def init_layers(self, seed: Optional[int] = None) -> None:
         """Reference init_layers (model.cc:897-901): run per-op init tasks.
         Here: initialize every Parameter on device with its sharding."""
@@ -975,27 +1008,10 @@ class FFModel:
             init = p.initializer or GlorotUniform()
             val = init(sub, p.shape, jnp.dtype(self.config.param_dtype)
                        if p.dtype == "float32" else jnp.dtype(p.dtype))
-            if p.name in getattr(self, "_host_shardings", {}):
-                val = jax.device_put(val, self._host_shardings[p.name])
-            elif self.mesh is not None and self.mesh.is_distributed:
-                pc = None
-                for lop in self.layers:
-                    if p in lop.weights:
-                        pc = lop.parallel_config
-                        break
-                spec = param_spec(p, pc, self.mesh)
-                val = self._put_global(val, self.mesh.sharding(spec))
-            params[p.name] = val
+            params[p.name] = self._placed_param(p, val)
         self._params = params
-        trainable = {}
-        for k, v in params.items():
-            if k not in self._split_params():
-                continue
-            if k in getattr(self, "_host_shardings", {}):
-                # optimizer slots stay in device memory even for host params
-                v = jax.device_put(v, self._dev_shardings[k])
-            trainable[k] = v
-        self._opt_state = self.optimizer.init_state(trainable)
+        self._opt_state = self.optimizer.init_state(
+            self._trainable_on_device(params))
         self._step = 0
 
     def share_weights(self, op: Op, source_op: Op) -> None:
@@ -1108,6 +1124,13 @@ class FFModel:
             final = self._ckpt_path(path)
             _cleanup_stale_tmps(final)
             step = self._step
+            # topology snapshot for the v2 manifest, captured NOW (the
+            # async writer thread must describe the mesh the state was
+            # gathered under, not whatever a later reshard() moved to)
+            mesh_shape = self._live_mesh_shape()
+            num_devices = self.mesh.num_devices if self.mesh else 1
+            process_count = jax.process_count()
+            digest = self._strategy_digest()
 
             def write():
                 # manifest here: writing rank only (the N-1 non-writers
@@ -1116,7 +1139,10 @@ class FFModel:
                 # rest of the slow serialization half, not on the train
                 # loop (flat is fully materialized at this point)
                 flat[MANIFEST_KEY] = np.asarray(
-                    build_manifest(flat, step))
+                    build_manifest(flat, step, mesh_shape=mesh_shape,
+                                   num_devices=num_devices,
+                                   process_count=process_count,
+                                   strategy_digest=digest))
                 _atomic_savez(final, flat)
                 faults.maybe_corrupt_checkpoint(final, step)
                 if keep_last is not None:
@@ -1175,15 +1201,25 @@ class FFModel:
         self.wait_for_checkpoint()  # never read under a pending writer
         path = self._ckpt_path(path)
         data = read_npz_verified(path, what="checkpoint")
+        # validate the checkpoint against THIS model before anything
+        # mutates: reshard-on-resume zero-fills params/opt state ahead
+        # of the restore, so a graph/optimizer mismatch discovered
+        # after it would leave the model destroyed, not untouched
+        # (shapes here are GLOBAL, so the check is mesh-independent)
+        self._validate_restore(data)
+        # topology mismatch (checkpoint saved on a different mesh) is a
+        # recoverable event, not an error: re-resolve strategies for the
+        # mesh we are actually on, THEN restore the global arrays under
+        # the (possibly new) shardings — reshard-on-resume
+        self._reshard_if_mesh_changed(data, path)
         self._restore_from_host(data)
 
-    def _restore_from_host(self, data: Dict[str, np.ndarray]) -> None:
-        """Validate + apply already-read (and already CRC-verified)
-        checkpoint arrays — the shared tail of :meth:`load_checkpoint`
-        and ``resilience.elastic_resume`` (which probes candidate files
-        with ``read_npz_verified`` and must not pay a second full read +
-        CRC pass for the winner)."""
-        assert self._compiled, "call compile() + init_layers() first"
+    def _validate_restore(self, data: Dict[str, np.ndarray]) -> None:
+        """Raise ``ValueError`` unless ``data`` matches this model's
+        parameter set/shapes and optimizer slot count/shapes (all
+        global, hence mesh-independent) — the no-mutation gate shared
+        by :meth:`load_checkpoint` and ``resilience.elastic_resume``,
+        run BEFORE reshard-on-resume can zero-fill state."""
         keys = set(data) - {MANIFEST_KEY}
         ckpt_params = {k[len("param:"):] for k in keys
                        if k.startswith("param:")}
@@ -1214,6 +1250,20 @@ class FFModel:
                 raise ValueError(
                     f"optimizer state mismatch: slot {i} shape "
                     f"{data[f'opt:{i}'].shape} != {tuple(leaf.shape)}")
+
+    def _restore_from_host(self, data: Dict[str, np.ndarray]) -> None:
+        """Apply already-read (and already CRC-verified) checkpoint
+        arrays — the shared tail of :meth:`load_checkpoint` and
+        ``resilience.elastic_resume`` (which probes candidate files
+        with ``read_npz_verified`` and must not pay a second full read +
+        CRC pass for the winner).  Both callers run
+        :meth:`_validate_restore` BEFORE reshard-on-resume — that is
+        the load-bearing no-mutation gate, not repeated here."""
+        assert self._compiled, "call compile() + init_layers() first"
+        keys = set(data) - {MANIFEST_KEY}
+        ckpt_params = {k[len("param:"):] for k in keys
+                       if k.startswith("param:")}
+        leaves, treedef = jax.tree_util.tree_flatten(self._opt_state)
         for name in ckpt_params:
             cur = self._params[name]
             val = data[f"param:{name}"].astype(cur.dtype)
@@ -1233,6 +1283,292 @@ class FFModel:
             if k.endswith("/" + name) or k.split("/")[0] == name:
                 return k
         raise KeyError(name)
+
+    # ------------------------------------------------------------------
+    # live elastic resharding (docs/elastic.md "Resharding"): a mesh
+    # grow/shrink is a recoverable event, not a restart-the-world crash
+    # ------------------------------------------------------------------
+    def _live_mesh_shape(self) -> Optional[Dict[str, int]]:
+        """Axis sizes > 1 of the current mesh (the canonical spelling
+        manifests and reshard events record; {} for a 1-device mesh)."""
+        if self.mesh is None:
+            return None
+        return {a: s for a, s in self.mesh.sizes.items() if s > 1}
+
+    def _strategy_digest(self) -> str:
+        """Digest of the resolved per-op strategy assignment (see
+        strategy.proto.strategy_digest) — recorded in checkpoint
+        manifests, compared at resume."""
+        from .strategy.proto import strategy_digest
+        return strategy_digest(
+            {op.name: op.parallel_config for op in self.layers})
+
+    def _reshard_budget(self) -> int:
+        """The search budget a reshard point may spend: the dedicated
+        ``reshard_search_budget`` when set, else the run's
+        ``search_budget`` (the ONE fallback rule, shared by reshard /
+        reshard-on-resume / the fault consumer)."""
+        cfg = self.config
+        return (cfg.reshard_search_budget
+                if cfg.reshard_search_budget is not None
+                else cfg.search_budget)
+
+    def reshard(self, new_mesh=None, num_devices: Optional[int] = None,
+                research: Optional[bool] = None,
+                verify: str = "warn",
+                redistribute: bool = True) -> Dict[str, Any]:
+        """Move LIVE training state onto a different mesh, in process —
+        the scale-up/down verb the elastic stack uses between dispatch
+        windows instead of restarting the world from a checkpoint.
+
+        Pass exactly one of ``new_mesh`` (a :class:`MachineMesh` or a
+        mesh-shape dict, used as given) or ``num_devices`` (a device
+        count; the mesh factorization is re-searched when re-search is
+        on, else pure data parallel).  Steps, in order:
+
+        1. **re-search** (``research``; default: on when the configured
+           budget — ``cfg.reshard_search_budget``, falling back to
+           ``cfg.search_budget`` — is > 0): re-run the SOAP strategy
+           search for the TARGET device count through the delta-sim
+           ``SimSession`` fast path (PR 1) and adopt the winning
+           strategies; an explicit ``new_mesh`` pins the search to
+           that factorization, so the strategies adopted are always
+           expressible on the mesh actually installed;
+        2. **verify**: the ``ffcheck`` static legality passes run
+           against the new mesh + strategies before anything moves
+           (``verify="error"`` aborts with the model UNCHANGED);
+        3. **re-trace**: step/eval/window programs are rebuilt for the
+           new mesh (compiled lazily at next dispatch through the
+           persistent compile cache; AOT inference buckets re-lower the
+           same way), and
+        4. **redistribute**: params and optimizer state are gathered to
+           full values and ``device_put`` into the new shardings — the
+           host copy of training state (step counter, metrics) is
+           untouched, and the redistribution is value-lossless
+           (checkpoint arrays are full/global, so post-reshard math on
+           mesh B is bit-identical to a run that was always on mesh B
+           from this state — tests/test_reshard.py pins it).
+
+        Single-controller only: in a multi-process world a mesh change
+        goes through the supervisor (degrade-and-continue +
+        reshard-on-resume).  Concurrency: a serving dispatcher attached
+        to the model keeps working across the move (executables are
+        looked up through the model's bucket cache, which this method
+        invalidates after the state swap) — a dispatch racing the swap
+        itself may fail transiently, which the engine's error path
+        turns into failed futures for that one batch, never a wedge.
+        ``redistribute=False`` skips moving the VALUES (params/opt
+        slots come out zero-filled under the new shardings) — for
+        callers about to overwrite every value anyway, like
+        reshard-on-resume, which restores from the checkpoint right
+        after; a multi-GB recovery should not pay a full gather+put of
+        state it is about to discard.  Returns a small report dict
+        (old/new mesh, device counts, whether re-search ran)."""
+        assert self._compiled, "call compile() + init_layers() first"
+        if (new_mesh is None) == (num_devices is None):
+            raise ValueError("pass exactly one of new_mesh / num_devices")
+        cfg = self.config
+        self.wait_for_checkpoint()  # the pending writer reads _params
+        mesh: Optional[MachineMesh] = None
+        if new_mesh is not None:
+            mesh = (new_mesh if isinstance(new_mesh, MachineMesh)
+                    else MachineMesh(dict(new_mesh)))
+            ndev = mesh.num_devices
+        else:
+            ndev = int(num_devices)
+            if not 1 <= ndev <= len(jax.devices()):
+                raise ValueError(
+                    f"num_devices={ndev} not in [1, {len(jax.devices())}]")
+        if research is None:
+            research = self._reshard_budget() > 0
+        old_shape = self._live_mesh_shape()
+        old_ndev = self.mesh.num_devices if self.mesh else 1
+
+        # ---- re-search strategies for the target machine (delta-sim
+        # SimSession path inside search()), adopting the searched mesh
+        # when the caller gave only a device count; an EXPLICIT mesh
+        # pins the search to that factorization — adopting strategies
+        # scored for a different one would silently replicate at trace
+        # time (FF106) instead of using the searched placement
+        new_strategies = None
+        if research:
+            from .search.mcmc import optimize_strategies
+            new_strategies, best_mesh = optimize_strategies(
+                self, cfg, num_devices=ndev,
+                budget=self._reshard_budget(), with_mesh=True,
+                mesh_shape=None if mesh is None else mesh.sizes)
+            if mesh is None:
+                shape = {a: s for a, s in best_mesh.items() if s > 1}
+                mesh = MachineMesh(shape or {"n": 1})
+        elif mesh is None:
+            mesh = MachineMesh({"n": ndev})
+
+        # ---- commit the new mesh + strategies, verify, rebuild; any
+        # verification error rolls back before state has moved
+        old_mesh_obj = self.mesh
+        old_configs = [op.parallel_config for op in self.layers]
+        if new_strategies is not None:
+            for op in self.layers:
+                op.parallel_config = new_strategies.get(op.name)
+        self.mesh = mesh
+
+        def _rollback():
+            # params/opt_state were never reassigned: restoring mesh +
+            # configs (+ the structures derived from them) returns the
+            # model to a fully trainable old-mesh state
+            self.mesh = old_mesh_obj
+            for op, pc in zip(self.layers, old_configs):
+                op.parallel_config = pc
+            self._resolve_host_placements()
+
+        try:
+            self._resolve_host_placements()
+            self._run_verifier(verify)
+        except Exception:
+            _rollback()
+            raise
+
+        # ---- rebuild + redistribute; a failure here (device OOM on a
+        # grow, a lowering error) also rolls the model back whole —
+        # cfg is only mutated after everything committed.  Values move
+        # as full host arrays -> new shardings; the optimizer pytree is
+        # rebuilt around the re-placed trainables so each slot leaf
+        # lands under exactly the sharding a fresh init_state would
+        # give it, then the SAVED slot values are put back
+        # leaf-for-leaf (same optimizer, same structure).  Without
+        # ``redistribute`` the new arrays are zero-filled sharding
+        # templates (see docstring).
+        try:
+            # gather full state only now that verification passed: a
+            # verify="error" abort stays free (no multi-GB device-to-
+            # host gather paid for a reshard that never happens, no
+            # host copies held live across the re-search above); the
+            # old arrays' shardings are self-contained, so gathering
+            # after the mesh commit is value-identical
+            host_params = host_leaves = None
+            if redistribute:
+                host_params = {k: self._gather_host(v)
+                               for k, v in self._params.items()}
+                leaves, _ = jax.tree_util.tree_flatten(self._opt_state)
+                host_leaves = [self._gather_host(v) for v in leaves]
+            self._build_step_fns()  # also drops stale AOT buckets
+            if redistribute:
+                new_params = {
+                    p.name: self._placed_param(p, host_params[p.name])
+                    for p in self.parameters}
+            else:
+                # host (calloc) zeros, NOT jnp.zeros: a full global-shape
+                # device allocation would OOM device 0 on exactly the
+                # large sharded models this cheap path exists for
+                new_params = {
+                    p.name: self._placed_param(
+                        p, np.zeros(self._params[p.name].shape,
+                                    self._params[p.name].dtype))
+                    for p in self.parameters}
+            proto_state = self.optimizer.init_state(
+                self._trainable_on_device(new_params))
+            if redistribute:
+                proto_leaves, proto_def = jax.tree_util.tree_flatten(
+                    proto_state)
+                assert len(proto_leaves) == len(host_leaves), \
+                    (len(proto_leaves), len(host_leaves))
+                new_opt = jax.tree_util.tree_unflatten(proto_def, [
+                    self._put_global(np.asarray(hv, pv.dtype), pv.sharding)
+                    for hv, pv in zip(host_leaves, proto_leaves)])
+            else:
+                new_opt = proto_state  # zeros under the right shardings
+        except Exception:
+            _rollback()
+            self._build_step_fns()  # re-trace for the restored mesh
+            raise
+        self._params = new_params
+        self._opt_state = new_opt
+        # a serving dispatcher racing this reshard may have re-lowered
+        # a bucket between the rebuild above and the params swap,
+        # caching an executable bound to the OLD params' shardings —
+        # drop any such entry now that the new params are visible (an
+        # in-flight dispatch can still fail transiently; the engine
+        # fails only that batch's futures and re-lowers fresh)
+        self._fwd_compiled = {}
+        if new_strategies is not None:
+            cfg.strategies.update(new_strategies)
+        cfg.mesh_shape = self._live_mesh_shape() or {"n": 1}
+        # stale per-batch caches placed under the old mesh
+        self._batch = None
+        self._cached_logits = None
+        self._cached_grads = None
+
+        report = {"old_mesh": old_shape, "new_mesh": self._live_mesh_shape(),
+                  "old_devices": old_ndev, "new_devices": mesh.num_devices,
+                  "researched": bool(research), "step": self._step,
+                  "strategy_digest": self._strategy_digest()}
+        from .fflogger import get_logger
+        get_logger("elastic").event("reshard", **report)
+        return report
+
+    def _reshard_if_mesh_changed(self, data: Dict[str, np.ndarray],
+                                 path: str = "<checkpoint>") -> bool:
+        """Reshard-on-resume detection: compare an already-read
+        checkpoint's v2 manifest topology against the mesh this model
+        is compiled for.  On a mesh change, emit one structured
+        ``reshard_on_resume`` event and — when re-search is configured
+        (``reshard_search_budget``/``search_budget`` > 0) — re-run
+        strategy search for the CURRENT device count via
+        :meth:`reshard` so the resumed run uses strategies searched for
+        the machine it actually has, not the machine that died.  v1 /
+        manifest-less checkpoints carry no topology and change nothing.
+        Returns True when a mismatch was detected."""
+        from .resilience import manifest_meta
+        meta = manifest_meta(data)
+        if meta is None:
+            return False
+        cur_shape = self._live_mesh_shape() or {}
+        cur_ndev = self.mesh.num_devices if self.mesh else 1
+        saved_shape = meta.get("mesh_shape")
+        saved_ndev = meta.get("num_devices")
+        mesh_changed = (
+            (saved_ndev is not None and saved_ndev != cur_ndev)
+            or (saved_shape is not None and saved_shape != cur_shape))
+        cur_digest = self._strategy_digest()
+        saved_digest = meta.get("strategy_digest")
+        digest_changed = saved_digest not in (None, cur_digest)
+        if not (mesh_changed or digest_changed):
+            return False
+        research = mesh_changed and self._reshard_budget() > 0
+        from .fflogger import get_logger
+        get_logger("elastic").event(
+            "reshard_on_resume", path=path,
+            saved_mesh=saved_shape, saved_devices=saved_ndev,
+            mesh=cur_shape, devices=cur_ndev,
+            saved_digest=saved_digest, digest=cur_digest,
+            research=bool(research))
+        if research:
+            # searched-for-THIS-machine strategies (and factorization);
+            # the caller restores the global arrays right after, under
+            # whatever shardings this resolves to — so skip moving the
+            # about-to-be-overwritten values (redistribute=False)
+            self.reshard(num_devices=cur_ndev, redistribute=False)
+        return True
+
+    def _apply_fault_reshard(self, kind: str,
+                             devices: Optional[int] = None) -> None:
+        """Consume a ``grow_at_step``/``shrink_at_step`` fault request
+        (faults.reshard_at_window): default scaling doubles/halves the
+        current device count (capped at the visible devices, floored at
+        1), landing on the data axis via ``mesh.scaled_shape`` unless a
+        re-search adopts a different factorization."""
+        cur = self.mesh.num_devices if self.mesh else 1
+        if devices is None:
+            devices = cur * 2 if kind == "grow_at_step" else max(1, cur // 2)
+        devices = max(1, min(int(devices), len(jax.devices())))
+        if devices == cur:
+            return
+        from .parallel.mesh import scaled_shape
+        if self._reshard_budget() > 0:
+            self.reshard(num_devices=devices)
+        else:
+            self.reshard(MachineMesh(
+                scaled_shape(self.mesh.sizes, devices)))
 
     # ------------------------------------------------------------------
     # training verbs (API parity with model.cc:897-940)
@@ -1417,6 +1753,38 @@ class FFModel:
             f"executor replicated requested splits; see "
             f"model.verify_report / flexflow-tpu lint")
 
+    def _maybe_reshard_fault(self, start: int, end: int) -> None:
+        """Consume every pending ``grow_at_step``/``shrink_at_step``
+        fault for the just-completed window ``(start, end]`` (no-op
+        without FF_FAULT) — the reshards run HERE, between dispatches,
+        exactly where a production scale event would land."""
+        for req in faults.reshard_at_window(start, end):
+            self._apply_fault_reshard(*req)
+
+    def _stale_under_mesh(self, arrays) -> bool:
+        """True when a staged jax array was placed under a mesh that is
+        no longer the model's — a reshard() landed between its prefetch
+        and its dispatch."""
+        if self.mesh is None:
+            return False
+        cur = self.mesh.mesh
+        for a in arrays:
+            m = getattr(getattr(a, "sharding", None), "mesh", None)
+            if m is not None and m != cur:
+                return True
+        return False
+
+    def _replace_stale(self, arrays, window: bool = False):
+        """Re-place prefetched arrays onto the CURRENT mesh when a
+        reshard invalidated their staging (via host — a committed
+        old-mesh array handed straight to jnp.asarray would stay
+        committed).  Cheap attribute check when nothing changed."""
+        if not self._stale_under_mesh(arrays):
+            return arrays
+        host = tuple(np.asarray(a) for a in jax.device_get(list(arrays)))
+        return tuple(self._shard_window(host) if window
+                     else self._shard_batch(host))
+
     def train_batch(self, *arrays) -> float:
         """One fused train step; returns loss."""
         if arrays:
@@ -1432,6 +1800,7 @@ class FFModel:
         # deterministic fault injection (no-op unless FF_FAULT is set):
         # the elastic recovery matrix kills/hangs/slows real train loops
         faults.on_step(self._step)
+        self._maybe_reshard_fault(self._step - 1, self._step)
         return loss
 
     def train_window(self, window, nvalid=None):
@@ -1460,6 +1829,10 @@ class FFModel:
             # dispatch would put per-array host work back on the hot
             # path this fusion exists to amortize
             window = tuple(self._shard_window(window))
+        else:
+            # ...unless a reshard() changed the mesh after this window
+            # was staged (cheap attribute check when nothing changed)
+            window = self._replace_stale(window, window=True)
         start = self._step
         with jax.profiler.StepTraceAnnotation("train_window",
                                               step_num=start):
@@ -1478,6 +1851,7 @@ class FFModel:
         self._step += w
         self._last_metric_sums = sums
         faults.on_window(start, self._step)  # no-op without FF_FAULT
+        self._maybe_reshard_fault(start, self._step)
         return losses, sums
 
     def fit(self, x, y, epochs: Optional[int] = None,
@@ -1560,6 +1934,10 @@ class FFModel:
                         epoch_sums.append(sums)
                 else:
                     for batch in loader:
+                        # a reshard() in the previous iteration (fault-
+                        # injected or explicit) invalidates the already-
+                        # prefetched batch's placement
+                        batch = self._replace_stale(batch)
                         t_d = time.perf_counter()
                         with jax.profiler.StepTraceAnnotation(
                                 "train", step_num=self._step):
@@ -1573,6 +1951,8 @@ class FFModel:
                         dispatches += 1
                         self._step += 1
                         faults.on_step(self._step)  # no-op without FF_FAULT
+                        self._maybe_reshard_fault(self._step - 1,
+                                                  self._step)
                         # keep losses/metric sums on device; fetching here
                         # would fence the async dispatch pipeline every step
                         epoch_losses.append(loss)

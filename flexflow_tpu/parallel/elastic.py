@@ -46,6 +46,7 @@ hangs.  Final per-rank steps are recorded in ``AttemptResult.rank_steps``
 from __future__ import annotations
 
 import dataclasses
+import inspect
 import os
 import random
 import socket
@@ -57,6 +58,29 @@ from typing import (Callable, Collection, Dict, List, Mapping, Optional,
 
 from ..faults import spawn_fail_requested
 from ..resilience import read_heartbeats
+
+
+def _call_sized(fn, attempt: int, port: int, rank: int, nprocs: int):
+    """Invoke a worker_argv/per_rank_env callback with the CURRENT world
+    size as a 4th argument when the callable accepts one — the degrade
+    policy can shrink the group between attempts, and a worker spawned
+    into the smaller world must be told its size.  3-arg callables (the
+    original contract, and every pre-degrade caller) keep working: only
+    a 4th REQUIRED positional opts in — defaulted extras (a 3-arg
+    callable with its own optional parameters) and ``*args`` catch-alls
+    stay on the legacy call, so nprocs never lands in an unrelated
+    parameter."""
+    try:
+        params = inspect.signature(fn).parameters.values()
+        nargs = sum(1 for p in params
+                    if p.kind in (p.POSITIONAL_ONLY,
+                                  p.POSITIONAL_OR_KEYWORD)
+                    and p.default is p.empty)
+    except (TypeError, ValueError):
+        nargs = 3
+    if nargs >= 4:
+        return fn(attempt, port, rank, nprocs)
+    return fn(attempt, port, rank)
 
 
 def free_port(avoid: Collection[int] = ()) -> int:
@@ -109,6 +133,10 @@ class AttemptResult:
     rank_steps: Dict[int, int] = dataclasses.field(default_factory=dict)
     #: backoff slept after this attempt, before the next one
     backoff_s: float = 0.0
+    #: world size this attempt ran with (the degrade-and-continue policy
+    #: may shrink it below the launch size — see run_elastic
+    #: min_processes)
+    num_processes: int = 0
 
 
 @dataclasses.dataclass
@@ -162,7 +190,9 @@ def run_elastic(worker_argv: Callable[[int, int, int], Sequence[str]],
                 backoff_max_s: float = 30.0,
                 backoff_jitter: float = 0.5,
                 backoff_seed: int = 0,
-                fail_fast_window_s: float = 2.0) -> ElasticReport:
+                fail_fast_window_s: float = 2.0,
+                min_processes: Optional[int] = None,
+                degrade_after: int = 2) -> ElasticReport:
     """Supervise ``num_processes`` workers; restart the whole group on
     any failure, at most ``max_restarts`` times.
 
@@ -183,6 +213,20 @@ def run_elastic(worker_argv: Callable[[int, int, int], Sequence[str]],
     exit on attempt 0 (within ``fail_fast_window_s``, cause ``crash``)
     aborts supervision immediately with ``fail_fast=True``.
 
+    **Degrade-and-continue** (``min_processes``): a production machine
+    that lost a rank does not get it back by retrying the dead topology
+    — after ``degrade_after`` consecutive topology-class failures
+    (``crash``/``hung``/``timeout``; spawn-class transients never
+    count), the group size is HALVED (not below ``min_processes``) and
+    supervision continues on the surviving mesh.  The shrunken world
+    size is passed to ``worker_argv``/``per_rank_env`` as an optional
+    4th argument (3-arg callables keep the fixed-size contract) and
+    exported as ``FF_ELASTIC_NPROCS``; workers resume from the newest
+    valid checkpoint and reshard onto their new mesh
+    (reshard-on-resume, docs/elastic.md "Resharding").  Each
+    ``AttemptResult.num_processes`` records the size its attempt ran
+    with, and every shrink emits a structured ``degrade`` event.
+
     Returns an :class:`ElasticReport`; ``success`` means some attempt
     had every worker exit 0."""
     attempts: List[AttemptResult] = []
@@ -190,6 +234,12 @@ def run_elastic(worker_argv: Callable[[int, int, int], Sequence[str]],
     backoffs = backoff_schedule(max_restarts, backoff_base_s,
                                 backoff_max_s, backoff_jitter, backoff_seed)
     prev_port: Optional[int] = None
+    nproc_cur = int(num_processes)
+    if min_processes is not None and not 1 <= min_processes <= num_processes:
+        raise ValueError(
+            f"min_processes={min_processes} must be in "
+            f"[1, num_processes={num_processes}]")
+    topo_fails = 0  # consecutive crash/hung/timeout at the current size
     for attempt in range(max_restarts + 1):
         port = free_port(avoid=() if prev_port is None else (prev_port,))
         prev_port = port
@@ -200,6 +250,7 @@ def run_elastic(worker_argv: Callable[[int, int, int], Sequence[str]],
             worker_env.update(env)
         worker_env["FF_ELASTIC_ATTEMPT"] = str(attempt)
         worker_env["FF_HEARTBEAT_DIR"] = hb_dir
+        worker_env["FF_ELASTIC_NPROCS"] = str(nproc_cur)
         procs: List[subprocess.Popen] = []
         # per-rank log FILES, not pipes: an undrained pipe blocks the
         # worker after ~64 KB of output (a verbose XLA warning dump
@@ -222,16 +273,18 @@ def run_elastic(worker_argv: Callable[[int, int, int], Sequence[str]],
                 if spawn_fail_requested(worker_env, attempt):
                     raise OSError(
                         f"injected spawn_fail_attempt:{attempt} (FF_FAULT)")
-                for rank in range(num_processes):
+                for rank in range(nproc_cur):
                     lf = open(os.path.join(logdir, f"rank{rank}.log"),
                               "w+b")
                     logs.append(lf)
                     env_r = worker_env
                     if per_rank_env is not None:
                         env_r = dict(worker_env)
-                        env_r.update(per_rank_env(attempt, port, rank))
+                        env_r.update(_call_sized(per_rank_env, attempt,
+                                                 port, rank, nproc_cur))
                     procs.append(subprocess.Popen(
-                        list(worker_argv(attempt, port, rank)),
+                        list(_call_sized(worker_argv, attempt, port,
+                                         rank, nproc_cur)),
                         stdout=lf, stderr=subprocess.STDOUT,
                         env=env_r))
             except OSError as e:
@@ -300,7 +353,8 @@ def run_elastic(worker_argv: Callable[[int, int, int], Sequence[str]],
             timed_out=timed_out or hung,
             elapsed_s=round(time.monotonic() - t0, 3), tails=tails,
             spawn_error=spawn_error, cause=cause,
-            rank_steps=read_heartbeats(hb_dir))
+            rank_steps=read_heartbeats(hb_dir),
+            num_processes=nproc_cur)
         attempts.append(result)
         if cause == "ok" and all(c == 0 for c in result.returncodes):
             return ElasticReport(True, attempts)
@@ -313,6 +367,24 @@ def run_elastic(worker_argv: Callable[[int, int, int], Sequence[str]],
             # codes are our own kills, excluded): argv/config error —
             # retrying max_restarts times would yield the same failure
             return ElasticReport(False, attempts, fail_fast=True)
+        # degrade-and-continue: repeated topology-class failures mean
+        # the machine shrank under us — stop retrying the dead world
+        # size, resume on the surviving mesh (spawn-class transients
+        # neither count nor reset the streak)
+        if cause in ("crash", "hung", "timeout"):
+            topo_fails += 1
+        if (min_processes is not None and nproc_cur > min_processes
+                and topo_fails >= max(1, int(degrade_after))):
+            # nproc_cur > min_processes >= 1 guarantees the halving
+            # (floored at the min) strictly shrinks the world
+            new_size = max(int(min_processes), nproc_cur // 2)
+            from ..fflogger import get_logger
+            get_logger("elastic").event(
+                "degrade", attempt=attempt, cause=cause,
+                from_processes=nproc_cur, to_processes=new_size,
+                consecutive_failures=topo_fails)
+            nproc_cur = new_size
+            topo_fails = 0
         if attempt < max_restarts and backoffs[attempt] > 0:
             result.backoff_s = round(backoffs[attempt], 3)
             time.sleep(backoffs[attempt])
@@ -331,16 +403,19 @@ def latest_checkpoint(directory: str, prefix: str = "elastic") -> Optional[str]:
 
 def latest_valid_checkpoint(directory: str,
                             prefix: str = "elastic") -> Optional[str]:
-    """Newest checkpoint in ``directory`` that passes
-    ``resilience.verify_checkpoint`` (full read + manifest CRCs),
-    falling back step by step past corrupt/truncated files.  A
-    bit-rotted newest checkpoint on shared storage therefore costs one
-    save interval instead of wedging every restart attempt in a
-    resume-crash loop."""
-    from ..resilience import verify_checkpoint
-    for _, path in _step_checkpoints(directory, prefix):
-        if verify_checkpoint(path):
-            return path
+    """Newest checkpoint in ``directory`` that passes verification
+    (full read + manifest CRCs, the ``resilience.verify_checkpoint``
+    predicate), falling back step by step past corrupt/truncated files.
+    A bit-rotted newest checkpoint on shared storage therefore costs
+    one save interval instead of wedging every restart attempt in a
+    resume-crash loop — and every skipped file is surfaced as a
+    structured ``checkpoint_skipped`` event naming the path and WHY
+    (an operator staring at a job that silently lost a save interval
+    deserves better than silence).  Shares the one scan implementation
+    with the worker-side ``resilience.elastic_resume``."""
+    from ..resilience import iter_valid_checkpoints
+    for _, path, _data in iter_valid_checkpoints(directory, prefix):
+        return path
     return None
 
 

@@ -202,6 +202,32 @@ class MachineMesh:
         return f"MachineMesh({live or {'n': 1}}, devices={self.num_devices})"
 
 
+def scaled_shape(sizes: Dict[str, int], num_devices: int) -> Dict[str, int]:
+    """Rescale a mesh's axis sizes to a new device count by resizing the
+    data axis ``n`` and keeping every other live axis — the default
+    grow/shrink policy of the elastic reshard path (``FFModel.reshard``
+    and the ``grow_at_step``/``shrink_at_step`` fault kinds): model/
+    sequence/expert parallel degrees are properties of the strategy, so
+    a capacity change lands on the data axis unless a re-search says
+    otherwise.  Raises when the surviving non-``n`` product does not
+    divide ``num_devices`` (e.g. shrinking a {n:2, c:4} mesh to 2
+    devices needs a real re-search, not an axis rescale)."""
+    if num_devices < 1:
+        raise ValueError(f"num_devices must be >= 1, got {num_devices}")
+    other = 1
+    for a, s in sizes.items():
+        if a != "n" and s > 1:
+            other *= int(s)
+    if num_devices % other:
+        raise ValueError(
+            f"cannot rescale mesh {dict(sizes)} to {num_devices} "
+            f"device(s): the non-'n' axes use {other} which does not "
+            f"divide it — reshard with an explicit mesh (or re-search)")
+    shape = {a: int(s) for a, s in sizes.items() if a != "n" and s > 1}
+    shape["n"] = num_devices // other
+    return shape
+
+
 def dim_axis_names(rank: int) -> Tuple[Optional[str], ...]:
     """Canonical logical-dim -> mesh-axis assignment by tensor rank.
 

@@ -41,7 +41,13 @@ from . import faults
 #: npz key holding the JSON manifest (kept in ``meta:`` space alongside
 #: ``meta:step`` so param/opt key enumeration is unaffected)
 MANIFEST_KEY = "meta:manifest"
-MANIFEST_VERSION = 1
+#: v1: per-array CRC32 table + step.  v2 adds the TOPOLOGY the
+#: checkpoint was saved under — ``mesh_shape``/``num_devices``/
+#: ``process_count``/``strategy_digest`` — so a resume can detect a
+#: mesh change and reshard instead of assuming the world it died on
+#: (docs/elastic.md "Resharding").  v1 and manifest-less archives keep
+#: verifying unchanged.
+MANIFEST_VERSION = 2
 
 
 class CorruptNpzError(RuntimeError):
@@ -76,10 +82,20 @@ def _crc(a: np.ndarray) -> int:
     return zlib.crc32(np.ascontiguousarray(a).tobytes())
 
 
-def build_manifest(arrays: Dict[str, np.ndarray], step: int) -> str:
+def build_manifest(arrays: Dict[str, np.ndarray], step: int,
+                   mesh_shape: Optional[Dict[str, int]] = None,
+                   num_devices: Optional[int] = None,
+                   process_count: Optional[int] = None,
+                   strategy_digest: Optional[str] = None) -> str:
     """JSON manifest for a checkpoint's arrays: per-array CRC32 + shape +
-    dtype, the step, and a format version."""
-    return json.dumps({
+    dtype, the step, and a format version — plus (v2) the topology the
+    checkpoint was saved under, when the writer knows it: mesh axis
+    sizes, device and process counts, and a digest of the resolved
+    parallel strategy (``strategy.proto.strategy_digest``).  The
+    topology fields are advisory (resume uses them to DETECT a mesh
+    change, never to place arrays — checkpoints always hold full global
+    arrays), so ``None`` simply omits them."""
+    man: Dict = {
         "format_version": MANIFEST_VERSION,
         "step": int(step),
         "arrays": {
@@ -87,7 +103,45 @@ def build_manifest(arrays: Dict[str, np.ndarray], step: int) -> str:
                 "shape": list(np.asarray(v).shape),
                 "dtype": str(np.asarray(v).dtype)}
             for k, v in arrays.items()},
-    }, sort_keys=True)
+    }
+    if mesh_shape is not None:
+        man["mesh_shape"] = {str(a): int(s) for a, s in mesh_shape.items()}
+    if num_devices is not None:
+        man["num_devices"] = int(num_devices)
+    if process_count is not None:
+        man["process_count"] = int(process_count)
+    if strategy_digest is not None:
+        man["strategy_digest"] = str(strategy_digest)
+    return json.dumps(man, sort_keys=True)
+
+
+def manifest_meta(data: Dict[str, np.ndarray]) -> Optional[Dict]:
+    """The parsed manifest of already-loaded checkpoint ``data`` with
+    the v2 topology fields normalized: keys ``format_version``/``step``
+    always present, ``mesh_shape``/``num_devices``/``process_count``/
+    ``strategy_digest`` present-or-None (v1 and partial manifests read
+    the same way).  None for manifest-less archives; an unreadable
+    manifest raises like :func:`verify_manifest` (the caller has
+    already decided to trust this file, so silence would hide rot)."""
+    if MANIFEST_KEY not in data:
+        return None
+    try:
+        man = json.loads(str(np.asarray(data[MANIFEST_KEY])))
+        meta = {"format_version": int(man["format_version"]),
+                "step": int(man["step"])}
+        mesh = man.get("mesh_shape")
+        meta["mesh_shape"] = ({str(a): int(s) for a, s in mesh.items()}
+                              if isinstance(mesh, dict) else None)
+        for k in ("num_devices", "process_count"):
+            v = man.get(k)
+            meta[k] = int(v) if v is not None else None
+        d = man.get("strategy_digest")
+        meta["strategy_digest"] = str(d) if d is not None else None
+    except (ValueError, KeyError, TypeError, AttributeError) as e:
+        raise CorruptCheckpointError(
+            f"checkpoint manifest is unreadable "
+            f"({type(e).__name__}: {e})") from e
+    return meta
 
 
 def verify_manifest(data: Dict[str, np.ndarray], path: str = "<npz>") -> None:
@@ -143,6 +197,28 @@ def read_npz_verified(path: str, what: str = "checkpoint"
             f"latest_valid_checkpoint() / elastic_resume()") from e
     verify_manifest(data, path)
     return data
+
+
+def iter_valid_checkpoints(directory: str, prefix: str = "elastic"):
+    """Yield ``(step, path, data)`` for every VERIFIED checkpoint in
+    ``directory`` newest-first (one full read + CRC pass each), emitting
+    a structured ``checkpoint_skipped`` event — path, step, why — for
+    every corrupt/truncated candidate instead of silence.  THE shared
+    scan under both resume paths: the supervisor-side
+    ``parallel.elastic.latest_valid_checkpoint`` and the worker-side
+    :func:`elastic_resume` must never diverge on what they skip or how
+    they report it."""
+    from .parallel.elastic import _step_checkpoints
+    for step, path in _step_checkpoints(directory, prefix):
+        try:
+            data = read_npz_verified(path, what="checkpoint")
+        except CorruptNpzError as e:
+            from .fflogger import get_logger
+            get_logger("elastic").event(
+                "checkpoint_skipped", path=path, step=step,
+                reason=f"{type(e).__name__}: {e}")
+            continue
+        yield step, path, data
 
 
 def verify_checkpoint(path: str) -> bool:
@@ -228,18 +304,26 @@ def elastic_resume(model, workdir: str, prefix: str = "elastic"
     checkpoint costs one save interval, not the whole job).  Returns the
     path resumed from, or None for a fresh start.
 
-    Probes candidates newest-first with a single read + CRC pass each
-    and restores straight from the winning read — a multi-GB checkpoint
-    on shared storage is not read twice per rank at the exact moment the
-    job is recovering (vs ``latest_valid_checkpoint`` +
-    ``load_checkpoint``, which would verify then re-read)."""
-    from .parallel.elastic import _step_checkpoints
+    Probes candidates newest-first (:func:`iter_valid_checkpoints` —
+    one read + CRC pass each, structured ``checkpoint_skipped`` events
+    for corrupt files) and restores straight from the winning read — a
+    multi-GB checkpoint on shared storage is not read twice per rank at
+    the exact moment the job is recovering (vs
+    ``latest_valid_checkpoint`` + ``load_checkpoint``, which would
+    verify then re-read).
+
+    Topology changes are first-class: when the winning checkpoint's
+    manifest records a different mesh than the model is compiled for
+    (the mesh shrank or grew between the save and this resume),
+    ``FFModel._reshard_if_mesh_changed`` re-resolves strategies for the
+    CURRENT mesh before the restore — reshard-on-resume
+    (docs/elastic.md "Resharding")."""
     model.wait_for_checkpoint()  # never read under a pending writer
-    for _, path in _step_checkpoints(workdir, prefix):
-        try:
-            data = read_npz_verified(path, what="checkpoint")
-        except CorruptNpzError:
-            continue
+    for _, path, data in iter_valid_checkpoints(workdir, prefix):
+        # graph/optimizer mismatch must fail with the model untouched —
+        # the reshard below zero-fills state ahead of the restore
+        model._validate_restore(data)
+        model._reshard_if_mesh_changed(data, path)
         model._restore_from_host(data)
         return path
     return None

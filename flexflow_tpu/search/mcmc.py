@@ -174,7 +174,8 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
            devices_per_slice=_UNSET, remat=_UNSET,
            compute_dtype=_UNSET, conv_layout=_UNSET,
            opt_slot_bytes=_UNSET, sparse_tables=_UNSET,
-           sim: Optional[Simulator] = None, chains: int = 1
+           sim: Optional[Simulator] = None, chains: int = 1,
+           fixed_mesh: Optional[MeshShape] = None
            ) -> Tuple[Dict[str, ParallelConfig], MeshShape, float]:
     """Run the annealing loop; returns (best strategies, best mesh
     factorization, best simulated time).  ``devices_per_slice`` < the
@@ -190,7 +191,13 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
     best strategy by (time, chain index) — deterministic under a fixed
     seed, and chain 0 reproduces the single-chain walk exactly.  Analytic
     chains run in threads (the native engine releases the GIL); measure
-    mode runs them sequentially to keep one on-chip profiling pipeline."""
+    mode runs them sequentially to keep one on-chip profiling pipeline.
+
+    ``fixed_mesh`` pins the global mesh factorization: the walk only
+    mutates per-op strategies on that mesh (no refactorization proposals,
+    seeds drawn from it alone).  The reshard path uses this when the
+    caller chose the mesh explicitly, so the returned strategies are
+    always expressible on the mesh that will actually be installed."""
     # one (name, value) table serves both branches: the contradiction
     # check against a shared sim AND the pass-through construction —
     # a new Simulator-mirrored kwarg is added in exactly one place
@@ -249,13 +256,23 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
     devices_per_slice = sim.devices_per_slice
     compute_dtype, conv_layout = sim.compute_dtype, sim.conv_layout
     opt_slot_bytes = sim.opt_slot_bytes
-    meshes = candidate_meshes(num_devices)
+    if fixed_mesh is not None:
+        pinned = {a: int(fixed_mesh.get(a, 1)) for a in AXES}
+        if _prod(pinned.values()) != num_devices:
+            raise ValueError(
+                f"fixed_mesh {fixed_mesh} has "
+                f"{_prod(pinned.values())} devices, expected {num_devices}")
+        meshes = [pinned]
+    else:
+        meshes = candidate_meshes(num_devices)
 
     def dp_mesh() -> MeshShape:
         return {a: (num_devices if a == "n" else 1) for a in AXES}
 
     # start from data parallelism on an all-data mesh (model.cc:1020-1027)
-    mesh_shape = dp_mesh()
+    # — or, under a pinned factorization, data parallelism over the
+    # pinned mesh's n axis (an all-data mesh would escape the pin)
+    mesh_shape = dict(meshes[0]) if fixed_mesh is not None else dp_mesh()
     cand_cache: Dict[Tuple[str, Tuple[int, ...]], List[ParallelConfig]] = {}
 
     def cands(op: Op, ms: MeshShape) -> List[ParallelConfig]:
@@ -268,7 +285,7 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
     for op in layers:
         nd = op.outputs[0].num_dims
         # largest expressible divisor of the n axis that divides the batch
-        deg = max((d for d in expressible_degrees(num_devices)
+        deg = max((d for d in expressible_degrees(mesh_shape["n"])
                    if op.outputs[0].shape[0] % d == 0), default=1)
         current[op.name] = ParallelConfig.data_parallel(deg, nd)
     cur_time = sim.simulate(layers, current, overlap_backward_update,
@@ -391,14 +408,30 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
     return best, best_mesh, best_time
 
 
-def optimize_strategies(model, cfg: FFConfig) -> Dict[str, ParallelConfig]:
+def optimize_strategies(model, cfg: FFConfig, num_devices: int = None,
+                        budget: int = None, with_mesh: bool = False,
+                        mesh_shape: Optional[Dict[str, int]] = None):
     """Entry point used by FFModel.compile when ``--budget > 0``
     (reference model.cc:953-966 launching STRATEGY_SEARCH_TASK).  Also
     pins ``cfg.mesh_shape`` to the searched factorization so compile()
-    builds the mesh the strategies were scored against."""
+    builds the mesh the strategies were scored against.
+
+    ``num_devices`` overrides the machine size — the elastic reshard
+    path (``FFModel.reshard``) re-searches for the mesh it is MOVING TO,
+    which is not the mesh the process booted with; an explicit override
+    also skips the ``cfg.mesh_shape`` pinning (the caller owns the mesh
+    decision).  ``budget`` overrides ``cfg.search_budget`` (reshard
+    points use the cheaper ``cfg.reshard_search_budget``), and
+    ``with_mesh=True`` returns ``(strategies, mesh_shape)`` so the
+    caller can adopt the searched factorization.  ``mesh_shape`` pins
+    the factorization (``search(fixed_mesh=...)``) — used when the
+    reshard caller chose the mesh, so strategies are searched for the
+    mesh that will actually be installed, never a different one."""
     import jax
 
-    ndev = cfg.num_devices if cfg.workers_per_node else len(jax.devices())
+    ndev = (int(num_devices) if num_devices is not None
+            else cfg.num_devices if cfg.workers_per_node
+            else len(jax.devices()))
     # --nodes N: each node/slice shares one ICI domain; weight sync
     # crossing it is costed over DCN (the reference's 12/numNodes GB/s
     # inter-node term, simulator.cu:27-29, was dead code here until r4)
@@ -421,7 +454,8 @@ def optimize_strategies(model, cfg: FFConfig) -> Dict[str, ParallelConfig]:
     # with the cheap sparse row-grad sync they can't actually use)
     sparse_tables = {t for _, t, _ in model._sparse_embedding_specs()}
     best, best_mesh, best_time = search(
-        model.layers, ndev, budget=cfg.search_budget,
+        model.layers, ndev,
+        budget=cfg.search_budget if budget is None else int(budget),
         alpha=cfg.search_alpha, seed=cfg.seed,
         measure=(cfg.simulator_mode == "measure"),
         overlap_backward_update=cfg.search_overlap_backward_update,
@@ -429,10 +463,10 @@ def optimize_strategies(model, cfg: FFConfig) -> Dict[str, ParallelConfig]:
         devices_per_slice=dps, remat=cfg.remat,
         compute_dtype=cfg.compute_dtype, conv_layout=layout,
         opt_slot_bytes=slot_bytes, sparse_tables=sparse_tables,
-        chains=cfg.search_chains)
+        chains=cfg.search_chains, fixed_mesh=mesh_shape)
     print(f"[search] best simulated iteration time: {best_time * 1e3:.3f} ms "
           f"on {ndev} devices, mesh "
           f"{ {a: s for a, s in best_mesh.items() if s > 1} }")
-    if cfg.mesh_shape is None:
+    if cfg.mesh_shape is None and num_devices is None:
         cfg.mesh_shape = {a: s for a, s in best_mesh.items() if s > 1}
-    return best
+    return (best, best_mesh) if with_mesh else best

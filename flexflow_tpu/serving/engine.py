@@ -287,12 +287,23 @@ class ServingEngine:
             try:
                 self._dispatch_batch(reqs)
             except BaseException as e:  # noqa: BLE001 — one poisoned
-                # batch must fail ITS futures, not kill the dispatcher.
-                # on_done reports whether it completed the LOGICAL
-                # request, so split chunks count their request once
+                # batch must fail ITS futures, not kill the dispatcher:
+                # the engine keeps serving subsequent batches.  on_done
+                # reports whether it completed the LOGICAL request, so
+                # split chunks count their request once (the same
+                # population serve_stats' ``errors`` counter reports).
                 now = self.clock()
                 failed = sum(1 for r in reqs if r.on_done(e, now))
                 self.metrics.record_errors(failed)
+                # one structured line per failed dispatch: a failure
+                # storm must be visible in the event stream, not only
+                # as a counter clients discover via exceptions
+                from ..fflogger import get_logger
+                get_logger("serve").event(
+                    "serve_dispatch_error",
+                    error=f"{type(e).__name__}: {e}"[:300],
+                    failed_requests=failed,
+                    errors_total=self.metrics.total_errors)
 
     def _dispatch_batch(self, reqs) -> None:
         import jax

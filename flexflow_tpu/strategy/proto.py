@@ -22,7 +22,7 @@ unpacked, matching proto2's default for repeated int32.
 from __future__ import annotations
 
 import io
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..config import DeviceType, MemoryType, ParallelConfig
 
@@ -224,3 +224,24 @@ def load_strategy_file(path: str) -> Dict[str, ParallelConfig]:
 def save_strategy_file(path: str, strategies: Dict[str, ParallelConfig]) -> None:
     with open(path, "wb") as f:
         f.write(dumps(strategies))
+
+
+def strategy_digest(strategies: Dict[str, Optional[ParallelConfig]]) -> str:
+    """Stable short digest of a resolved strategy assignment, recorded
+    in checkpoint manifests (resilience.build_manifest) so a resume can
+    tell whether the checkpoint was trained under the SAME parallel
+    strategy it is about to run — a mismatch is what triggers the
+    reshard-on-resume path (docs/elastic.md "Resharding").  Ops without
+    a config hash as such (the data-parallel default), name order is
+    canonicalized, and the wire encoding of :func:`dumps` supplies the
+    value normalization, so the digest is independent of dict insertion
+    order and of how the strategy was produced (searched / imported /
+    hand-built)."""
+    import hashlib
+    resolved = {n: pc for n, pc in sorted(strategies.items())
+                if pc is not None}
+    blob = dumps(resolved)
+    absent = ",".join(n for n, pc in sorted(strategies.items())
+                      if pc is None)
+    h = hashlib.sha256(blob + b"\x00" + absent.encode("utf-8"))
+    return h.hexdigest()[:16]

@@ -19,6 +19,10 @@ SLOW_TIMEOUT=${SLOW_TIMEOUT:-900}
 
 declare -a cases=(
   "$FAST_TIMEOUT tests/test_faults.py"
+  # grow_at_step / shrink_at_step: in-process live resharding, pinned
+  # bit-identical against fixed-mesh references (docs/elastic.md
+  # "Resharding"; single-process, 8 virtual CPU devices — tier-1 speed)
+  "$FAST_TIMEOUT tests/test_reshard.py"
 )
 if [ "${1:-}" != "--fast-only" ]; then
   cases+=(

@@ -26,6 +26,14 @@ by tier-1 ``tests/test_static_checks.py``).  Rules:
   batch (ISSUE 4's fused-dispatch fix: accumulate on device, fetch
   ONCE after the loop).  The per-EPOCH loop (``for epoch in ...``) is
   exempt — an epoch-boundary fetch is the intended sync point.
+* **RL006 — device meshes are built ONLY in ``parallel/mesh.py``**: a
+  ``jax.sharding.Mesh(...)`` / ``jax.make_mesh(...)`` constructed
+  anywhere else in ``flexflow_tpu/`` bypasses ``MachineMesh`` — the
+  reshard-aware mesh factory the live-resharding path (ISSUE 6)
+  rebuilds state against.  A raw Mesh smuggled past it would keep
+  working until the first ``reshard()``/resume-on-new-mesh, then
+  silently disagree with the model's placement.  Tests may build raw
+  meshes (they pin jax-level behavior).
 * **RL005 — no per-request host syncs in the serving dispatch path**
   (the serve-side mirror of RL004, ISSUE 5): inside the dispatch
   functions of ``flexflow_tpu/serving/`` (``_dispatch_loop`` /
@@ -95,6 +103,7 @@ class _Visitor(ast.NodeVisitor):
             or relpath == "flexflow_tpu/parallel/sharding.py")
         self.in_tests = relpath.startswith("tests/")
         self.in_serving = relpath.startswith("flexflow_tpu/serving/")
+        self.is_mesh_factory = relpath == "flexflow_tpu/parallel/mesh.py"
         self._hot_func: Optional[str] = None  # inside fit/evaluate/predict
         self._batch_loops = 0                 # nested non-epoch loop depth
         self._serve_func: Optional[str] = None  # inside _dispatch_*
@@ -110,7 +119,19 @@ class _Visitor(ast.NodeVisitor):
             self._check_warn(node, name)
             self._check_rng(node, name)
             self._check_step_sync(node, name)
+            self._check_raw_mesh(node, name)
         self.generic_visit(node)
+
+    def _check_raw_mesh(self, node: ast.Call, name: str) -> None:
+        if not self.in_library or self.is_mesh_factory:
+            return
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf in ("Mesh", "make_mesh"):
+            self._add(node, "RL006",
+                      f"raw {name}() outside parallel/mesh.py — build "
+                      f"device meshes through MachineMesh so the live-"
+                      f"reshard path (FFModel.reshard, reshard-on-"
+                      f"resume) sees every mesh the repo constructs")
 
     # --- RL004/RL005 scope tracking -----------------------------------
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:

@@ -195,13 +195,13 @@ def test_async_checkpoint_roundtrip(tmp_path):
 
 def test_checkpoint_embeds_verifying_manifest(tmp_path):
     """save_checkpoint embeds a per-array CRC32 manifest (step + format
-    version) that resilience.verify_checkpoint accepts, and that covers
-    every array in the archive."""
+    version + the v2 topology fields) that resilience.verify_checkpoint
+    accepts, and that covers every array in the archive."""
     import json
 
     from flexflow_tpu.resilience import MANIFEST_KEY, verify_checkpoint
 
-    a = _model()
+    a = _model({"n": 8})
     x, y = _data()
     a.train_batch(x, y)
     ckpt = os.path.join(tmp_path, "man.npz")
@@ -210,9 +210,88 @@ def test_checkpoint_embeds_verifying_manifest(tmp_path):
     with np.load(ckpt) as f:
         assert MANIFEST_KEY in f.files
         man = json.loads(str(np.asarray(f[MANIFEST_KEY])))
-        assert man["format_version"] == 1
+        assert man["format_version"] == 2
         assert man["step"] == 1
         assert set(man["arrays"]) == set(f.files) - {MANIFEST_KEY}
+        # v2 topology record (reshard-on-resume reads these)
+        assert man["mesh_shape"] == {"n": 8}
+        assert man["num_devices"] == 8
+        assert man["process_count"] == 1
+        assert man["strategy_digest"] == a._strategy_digest()
+
+
+def test_manifest_v1_and_manifestless_backcompat(tmp_path):
+    """Archives from before the v2 topology fields keep loading: a v1
+    manifest (CRC table only — no mesh fields) verifies and restores
+    without triggering any reshard; a manifest-less archive loads after
+    the readability check, as always."""
+    import json
+
+    from flexflow_tpu.resilience import (MANIFEST_KEY, manifest_meta,
+                                         _atomic_savez, read_npz_verified,
+                                         verify_checkpoint)
+
+    a = _model()
+    x, y = _data()
+    a.train_batch(x, y)
+    v2 = os.path.join(tmp_path, "v2.npz")
+    a.save_checkpoint(v2)
+
+    # rewrite the archive with its manifest downgraded to v1 (exactly
+    # the fields the PR 2 writer produced), CRC table intact
+    data = read_npz_verified(v2)
+    man = json.loads(str(np.asarray(data[MANIFEST_KEY])))
+    man_v1 = {"format_version": 1, "step": man["step"],
+              "arrays": man["arrays"]}
+    data[MANIFEST_KEY] = np.asarray(json.dumps(man_v1, sort_keys=True))
+    v1 = _atomic_savez(os.path.join(tmp_path, "v1.npz"), data)
+    assert verify_checkpoint(v1)
+    meta = manifest_meta(read_npz_verified(v1))
+    assert meta["format_version"] == 1
+    assert meta["mesh_shape"] is None and meta["num_devices"] is None
+    assert meta["strategy_digest"] is None
+    b = _model()
+    b.load_checkpoint(v1)  # no topology info -> no reshard, clean load
+    assert b._step == 1
+
+    # manifest-less: strip the key entirely
+    bare = {k: v for k, v in data.items() if k != MANIFEST_KEY}
+    v0 = _atomic_savez(os.path.join(tmp_path, "v0.npz"), bare)
+    assert verify_checkpoint(v0)
+    assert manifest_meta(read_npz_verified(v0)) is None
+    c = _model()
+    c.load_checkpoint(v0)
+    assert c._step == 1
+
+
+def test_corrupt_newest_with_valid_older_under_retention(tmp_path):
+    """Cross-feature pin (supervisor fallback x keep_last retention):
+    after retention pruned the family to the newest K files, a corrupt
+    NEWEST checkpoint still falls back to the valid older sibling —
+    retention must never leave the fallback path empty-handed."""
+    from flexflow_tpu import faults
+    from flexflow_tpu.parallel.elastic import (latest_checkpoint,
+                                               latest_valid_checkpoint)
+
+    a = _model()
+    x, y = _data()
+    for _ in range(4):
+        a.train_batch(x, y)
+        a.save_checkpoint(
+            os.path.join(tmp_path, f"elastic_step{a._step}"), keep_last=2)
+    kept = sorted(n for n in os.listdir(tmp_path) if n.endswith(".npz"))
+    assert kept == ["elastic_step3.npz", "elastic_step4.npz"]
+    newest = os.path.join(tmp_path, "elastic_step4.npz")
+    faults.corrupt_file(newest)
+    assert latest_checkpoint(str(tmp_path)) == newest  # trusting probe
+    assert latest_valid_checkpoint(str(tmp_path)) == \
+        os.path.join(tmp_path, "elastic_step3.npz")
+    # and the worker-side resume actually restores from the survivor
+    from flexflow_tpu.resilience import elastic_resume
+    b = _model()
+    resumed = elastic_resume(b, str(tmp_path))
+    assert resumed is not None and resumed.endswith("elastic_step3.npz")
+    assert b._step == 3
 
 
 def test_corrupt_checkpoint_raises_clear_error(tmp_path):

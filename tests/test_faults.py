@@ -62,6 +62,24 @@ def test_parse_rejects_typos():
         faults.parse_faults("kill_at_step:7,bogus=1")
 
 
+def test_parse_reshard_kinds():
+    """grow_at_step/shrink_at_step parse like the other step kinds:
+    integer arg, rank=/attempt= scoping, devices= target validated at
+    parse time."""
+    grow, shrink = faults.parse_faults(
+        "grow_at_step:5;shrink_at_step:3,devices=2,rank=1")
+    assert grow.kind == "grow_at_step" and grow.arg == "5"
+    assert grow.attempt == 0 and "devices" not in grow.extras
+    assert shrink.kind == "shrink_at_step"
+    assert shrink.extras["devices"] == "2" and shrink.rank == 1
+    with pytest.raises(ValueError, match="must be an integer"):
+        faults.parse_faults("shrink_at_step:half")
+    with pytest.raises(ValueError, match="devices"):
+        faults.parse_faults("grow_at_step:5,devices=0")
+    with pytest.raises(ValueError):
+        faults.parse_faults("grow_at_step:5,devices=x")
+
+
 @pytest.fixture
 def fault_env(monkeypatch):
     """Install an FF_FAULT plan for the current process, undone (cache
@@ -347,6 +365,105 @@ def test_free_port_avoids_previous():
 
 
 # ----------------------------------------------------------------------
+# degrade-and-continue: lost capacity -> resume on the surviving mesh
+# ----------------------------------------------------------------------
+def test_call_sized_argument_contract():
+    """Only a 4th REQUIRED positional receives the world size: defaulted
+    extras and *args catch-alls keep the legacy 3-arg call (nprocs must
+    never land in an unrelated optional parameter)."""
+    from flexflow_tpu.parallel.elastic import _call_sized
+    assert _call_sized(lambda a, p, r: (a, p, r), 1, 2, 3, 8) == (1, 2, 3)
+    assert _call_sized(lambda a, p, r, n: n, 1, 2, 3, 8) == 8
+    assert _call_sized(lambda a, p, r, extra="x": extra, 1, 2, 3, 8) == "x"
+    assert _call_sized(lambda *a: a, 1, 2, 3, 8) == (1, 2, 3)
+
+
+def test_degrade_halves_world_until_survivable(capsys):
+    """min_processes: after degrade_after consecutive topology-class
+    failures the group halves instead of retrying the dead size forever;
+    workers see the CURRENT size (4th argv arg), each attempt records
+    its num_processes, and every shrink emits a structured event."""
+    def argv(attempt, port, rank, nprocs):
+        # crash while the world is wider than 1 process
+        return [sys.executable, "-c",
+                "import sys; sys.exit(1 if int(sys.argv[1]) > 1 else 0)",
+                str(nprocs)]
+
+    report = run_elastic(argv, num_processes=4, max_restarts=3,
+                         attempt_timeout_s=30, poll_interval_s=0.05,
+                         backoff_base_s=0.01, fail_fast_window_s=0.0,
+                         min_processes=1, degrade_after=1)
+    assert report.success
+    assert [a.num_processes for a in report.attempts] == [4, 2, 1]
+    assert [a.cause for a in report.attempts] == ["crash", "crash", "ok"]
+    import json
+    events = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+              if l.startswith("{")]
+    degrades = [e for e in events if e["event"] == "degrade"]
+    assert [(d["from_processes"], d["to_processes"]) for d in degrades] \
+        == [(4, 2), (2, 1)]
+
+
+def test_degrade_never_below_min_processes():
+    """The floor holds: with min_processes=2 a deterministic crasher
+    exhausts restarts at 2 instead of shrinking to 1."""
+    def argv(attempt, port, rank, nprocs):
+        return [sys.executable, "-c", "import sys; sys.exit(1)"]
+
+    report = run_elastic(argv, num_processes=4, max_restarts=2,
+                         attempt_timeout_s=30, poll_interval_s=0.05,
+                         backoff_base_s=0.01, fail_fast_window_s=0.0,
+                         min_processes=2, degrade_after=1)
+    assert not report.success
+    assert [a.num_processes for a in report.attempts] == [4, 2, 2]
+
+
+def test_degrade_not_triggered_by_spawn_failures():
+    """Spawn-class transients are not topology evidence: the injected
+    spawn fault consumes a restart at FULL size."""
+    def argv(attempt, port, rank):  # 3-arg contract still supported
+        return [sys.executable, "-c", "pass"]
+
+    report = run_elastic(argv, num_processes=2, max_restarts=1,
+                         attempt_timeout_s=30, poll_interval_s=0.05,
+                         backoff_base_s=0.01,
+                         min_processes=1, degrade_after=1,
+                         env={"FF_FAULT": "spawn_fail_attempt:0"})
+    assert report.success
+    assert [a.num_processes for a in report.attempts] == [2, 2]
+    assert report.attempts[0].cause == "spawn"
+
+
+def test_degrade_off_without_min_processes():
+    """Default (min_processes=None): the fixed-size contract of PR 2 is
+    untouched — every attempt runs at the launch size."""
+    def argv(attempt, port, rank):
+        return [sys.executable, "-c", "import sys; sys.exit(1)"]
+
+    report = run_elastic(argv, num_processes=2, max_restarts=2,
+                         attempt_timeout_s=30, poll_interval_s=0.05,
+                         backoff_base_s=0.01, fail_fast_window_s=0.0)
+    assert not report.success
+    assert [a.num_processes for a in report.attempts] == [2, 2, 2]
+
+
+def test_latest_valid_checkpoint_emits_skip_event(tmp_path, capsys):
+    """The newest-valid fallback names what it skipped and why, as a
+    structured event — an operator can see the lost save interval."""
+    ok = _write_ckpt(str(tmp_path / "elastic_step2.npz"), step=2)
+    bad = _write_ckpt(str(tmp_path / "elastic_step4.npz"), step=4)
+    faults.corrupt_file(bad)
+    assert latest_valid_checkpoint(str(tmp_path)) == ok
+    import json
+    events = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+              if l.startswith("{")]
+    skips = [e for e in events if e["event"] == "checkpoint_skipped"]
+    assert len(skips) == 1
+    assert skips[0]["path"] == bad and skips[0]["step"] == 4
+    assert "Corrupt" in skips[0]["reason"] or "corrupt" in skips[0]["reason"]
+
+
+# ----------------------------------------------------------------------
 # window-boundary fault semantics (fused multi-step dispatch, ISSUE 4:
 # FFConfig.steps_per_dispatch > 1 re-enters Python once per K-step
 # window, so kill/hang step indices round UP to the window edge)
@@ -413,6 +530,38 @@ def test_kill_exact_window_edge_no_rounding_note(tmp_path):
                         capture_output=True, text=True, timeout=30)
     assert r1.returncode == faults.KILL_EXIT_CODE
     assert "edge 7" in r1.stdout and "edge 8" not in r1.stdout
+
+
+def test_reshard_at_window_hook(fault_env):
+    """The reshard fire point: fires only in the window CONTAINING the
+    step (rounded up to the edge), returns every matching (kind,
+    devices) request, honors rank scoping, and never fires twice — the
+    train loop consumes it at exactly one dispatch boundary."""
+    fault_env("shrink_at_step:5,devices=2", rank=0)
+    assert faults.reshard_at_window(0, 4) == []         # before
+    assert faults.reshard_at_window(4, 8) == [("shrink_at_step", 2)]
+    assert faults.reshard_at_window(8, 12) == []        # after: once
+    # default devices -> None (the consumer doubles/halves)
+    fault_env("grow_at_step:2", rank=0)
+    assert faults.reshard_at_window(1, 2) == [("grow_at_step", None)]
+    # a wide window covering TWO scheduled reshards returns both, in
+    # spec order — dropping the second would change the injected plan
+    fault_env("grow_at_step:3;shrink_at_step:6,devices=2", rank=0)
+    assert faults.reshard_at_window(0, 8) == [
+        ("grow_at_step", None), ("shrink_at_step", 2)]
+    # rank scoping: another rank never sees the request
+    fault_env("shrink_at_step:5,devices=2,rank=1", rank=0)
+    assert faults.reshard_at_window(4, 8) == []
+    faults.set_rank(1)
+    assert faults.reshard_at_window(4, 8) == [("shrink_at_step", 2)]
+    # attempt scoping (default attempt=0): a restarted attempt must not
+    # re-fire the reshard
+    fault_env("shrink_at_step:5", rank=0)
+    os.environ["FF_ELASTIC_ATTEMPT"] = "1"
+    try:
+        assert faults.reshard_at_window(4, 8) == []
+    finally:
+        del os.environ["FF_ELASTIC_ATTEMPT"]
 
 
 def test_hang_rounds_up_to_window_edge():

@@ -1,0 +1,423 @@
+"""Live elastic resharding (ISSUE 6): a mesh grow/shrink is a
+recoverable event, not a restart-the-world crash.
+
+Two layers, both pinned BIT-IDENTICAL against fixed-mesh references
+(same tolerance discipline as tests/test_elastic.py — the redistribution
+gathers full global arrays and re-places them, so post-reshard math on
+mesh B must equal a run that was always on mesh B from that state):
+
+* **reshard-on-resume** — a checkpoint saved on mesh A loads into a
+  model compiled for mesh B (the v2 manifest records the saved
+  topology; the mismatch is detected and surfaced, params device_put
+  into the new shardings) and TRAINS there;
+* **in-process reshard** — ``FFModel.reshard`` moves live params +
+  optimizer state + step onto a new mesh between dispatches, including
+  the ``grow_at_step``/``shrink_at_step`` fault-injected path through
+  the real train loop (train_batch and fused windows).
+
+Single-process over the suite's 8 virtual CPU devices — tier-1 speed;
+scripts/fault_matrix.sh runs this file in the fault matrix.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu import faults
+from flexflow_tpu.parallel.mesh import MachineMesh, scaled_shape
+
+BS = 16
+NFEAT = 8
+NCLS = 4
+
+
+def _model(mesh_shape, budget=0):
+    cfg = ff.FFConfig(batch_size=BS, compute_dtype="float32")
+    cfg.reshard_search_budget = budget
+    m = ff.FFModel(cfg, mesh=MachineMesh(mesh_shape))
+    x = m.create_tensor((BS, NFEAT), name="x")
+    t = m.dense(x, 32, activation="relu")
+    t = m.dense(t, NCLS)
+    m.compile(ff.SGDOptimizer(lr=0.1, momentum=0.9),
+              "sparse_categorical_crossentropy", [], final_tensor=t)
+    m.init_layers(seed=0)
+    return m
+
+
+def _batch(step):
+    """Deterministic per-step batch: a resharded run and its fixed-mesh
+    reference replay the exact same data sequence."""
+    rng = np.random.default_rng(1000 + step)
+    return (rng.standard_normal((BS, NFEAT)).astype(np.float32),
+            rng.integers(0, NCLS, (BS, 1)).astype(np.int32))
+
+
+def _train(m, steps):
+    return [float(m.train_batch(*_batch(m._step))) for _ in range(steps)]
+
+
+@pytest.fixture
+def fault_env(monkeypatch):
+    def install(value):
+        monkeypatch.setenv("FF_FAULT", value)
+        faults.reset()
+        faults.set_rank(0)
+    yield install
+    faults.reset()
+
+
+def _mesh_b_reference(tmp_path, pre_steps=3, post_steps=3,
+                      mesh_a={"n": 4}, mesh_b={"n": 2}):
+    """(pre_losses on mesh A, post_losses of a model FIXED on mesh B
+    resumed from the step-``pre_steps`` checkpoint) — the ground truth
+    every reshard path below must hit bit-identically."""
+    a = _model(mesh_a)
+    pre = _train(a, pre_steps)
+    ckpt = os.path.join(tmp_path, "mesh_a.npz")
+    a.save_checkpoint(ckpt)
+    b = _model(mesh_b)
+    b.load_checkpoint(ckpt)  # reshard-on-resume: topology mismatch
+    assert b._step == pre_steps
+    post = _train(b, post_steps)
+    return pre, post
+
+
+# ----------------------------------------------------------------------
+# reshard-on-resume: checkpoint saved on mesh A loads + trains on mesh B
+# ----------------------------------------------------------------------
+def test_checkpoint_cross_mesh_load_and_train(tmp_path, capsys):
+    """The acceptance pin: a checkpoint saved on a 4-device mesh
+    demonstrably loads into a 2-device model, the mismatch is surfaced
+    as a structured event, and training continues (state intact:
+    momentum + step counter included, so a second resume on the SAME
+    mesh reproduces the trajectory bitwise)."""
+    pre, post = _mesh_b_reference(tmp_path)
+    events = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+              if l.startswith("{")]
+    resumes = [e for e in events if e["event"] == "reshard_on_resume"]
+    assert resumes, events
+    assert resumes[0]["saved_mesh"] == {"n": 4}
+    assert resumes[0]["saved_devices"] == 4
+    assert resumes[0]["devices"] == 2
+
+    # same-mesh replay of the same checkpoint: bit-identical trajectory
+    b2 = _model({"n": 2})
+    b2.load_checkpoint(os.path.join(tmp_path, "mesh_a.npz"))
+    assert _train(b2, len(post)) == post
+
+
+def test_elastic_resume_onto_new_mesh(tmp_path):
+    """The worker-side resume pattern (resilience.elastic_resume) does
+    the same: the newest valid elastic checkpoint from a 4-device run
+    resumes into a 2-device model."""
+    from flexflow_tpu.resilience import elastic_resume
+
+    a = _model({"n": 4})
+    _train(a, 2)
+    a.save_checkpoint(os.path.join(tmp_path, "elastic_step2"))
+    _train(a, 2)
+    a.save_checkpoint(os.path.join(tmp_path, "elastic_step4"))
+
+    b = _model({"n": 2})
+    resumed = elastic_resume(b, str(tmp_path))
+    assert resumed is not None and resumed.endswith("elastic_step4.npz")
+    assert b._step == 4
+    losses = _train(b, 2)
+    assert all(np.isfinite(losses))
+
+
+# ----------------------------------------------------------------------
+# in-process reshard: live state moves, trajectory matches fixed mesh B
+# ----------------------------------------------------------------------
+def test_reshard_shrink_matches_fixed_mesh_run(tmp_path):
+    """model.reshard({"n": 4} -> {"n": 2}) mid-run: the post-reshard
+    loss trajectory is BIT-IDENTICAL to the fixed-mesh-B reference
+    resumed from the same state (redistribution is value-lossless)."""
+    pre_ref, post_ref = _mesh_b_reference(tmp_path)
+    m = _model({"n": 4})
+    pre = _train(m, 3)
+    assert pre == pre_ref
+    report = m.reshard(new_mesh={"n": 2})
+    assert report["old_devices"] == 4 and report["new_devices"] == 2
+    assert report["step"] == 3 and m._step == 3
+    assert m.mesh.num_devices == 2
+    assert _train(m, 3) == post_ref
+
+
+def test_reshard_grow_matches_fixed_mesh_run(tmp_path):
+    pre_ref, post_ref = _mesh_b_reference(tmp_path, mesh_a={"n": 2},
+                                          mesh_b={"n": 8})
+    m = _model({"n": 2})
+    assert _train(m, 3) == pre_ref
+    m.reshard(new_mesh={"n": 8})
+    assert m.mesh.num_devices == 8
+    assert _train(m, 3) == post_ref
+
+
+def test_reshard_preserves_optimizer_state(tmp_path):
+    """Momentum slots survive the move: a reshard followed by a save
+    round-trips bit-identical state to a no-reshard save."""
+    a = _model({"n": 4})
+    _train(a, 3)
+    ck_a = os.path.join(tmp_path, "before.npz")
+    a.save_checkpoint(ck_a)
+    a.reshard(new_mesh={"n": 2})
+    ck_b = os.path.join(tmp_path, "after.npz")
+    a.save_checkpoint(ck_b)
+    with np.load(ck_a) as fa, np.load(ck_b) as fb:
+        keys = [k for k in fa.files if k != "meta:manifest"]
+        assert set(keys) == set(k for k in fb.files
+                                if k != "meta:manifest")
+        for k in keys:
+            np.testing.assert_array_equal(fa[k], fb[k], err_msg=k)
+
+
+def test_reshard_validates_arguments():
+    m = _model({"n": 2})
+    with pytest.raises(ValueError, match="exactly one"):
+        m.reshard()
+    with pytest.raises(ValueError, match="exactly one"):
+        m.reshard(new_mesh={"n": 2}, num_devices=2)
+    with pytest.raises(ValueError, match="num_devices"):
+        m.reshard(num_devices=512)
+
+
+def test_reshard_verify_error_rolls_back():
+    """verify="error" with an illegal strategy for the target mesh
+    aborts BEFORE any state moves: the model keeps its old mesh,
+    strategies, and keeps training."""
+    from flexflow_tpu.analysis import VerificationError
+    from flexflow_tpu.config import ParallelConfig
+
+    m = _model({"n": 4})
+    _train(m, 1)
+    # illegal on the target: 3 parts divide neither the batch dim (16)
+    # nor a 2-device mesh axis
+    bad = ParallelConfig(dims=(3, 1), device_ids=(0, 1, 2))
+    m.layers[0].parallel_config = bad
+    with pytest.raises(VerificationError):
+        m.reshard(new_mesh={"n": 2}, verify="error")
+    assert m.mesh.num_devices == 4  # untouched
+    assert m.layers[0].parallel_config is bad
+    m.layers[0].parallel_config = None
+    assert np.isfinite(_train(m, 1)[0])
+
+
+def test_reshard_research_adopts_searched_strategies():
+    """research=True re-runs the SOAP search (SimSession delta path)
+    for the TARGET device count and adopts its strategies + mesh; the
+    model keeps training on the result."""
+    m = _model({"n": 2}, budget=8)
+    _train(m, 1)
+    report = m.reshard(num_devices=4, research=True)
+    assert report["researched"] is True
+    assert m.mesh.num_devices <= 4
+    # search resolved a config for every op
+    assert all(op.parallel_config is not None for op in m.layers)
+    assert np.isfinite(_train(m, 1)[0])
+
+
+def test_reshard_explicit_mesh_pins_research():
+    """research=True with an EXPLICIT new_mesh constrains the re-search
+    to that factorization: the installed mesh is the caller's, and every
+    adopted strategy is expressible on it (an unconstrained search could
+    return strategies scored for a different factorization, which would
+    silently replicate at trace time instead of erroring)."""
+    from flexflow_tpu.analysis.legality import per_dim_degrees
+
+    m = _model({"n": 2}, budget=8)
+    _train(m, 1)
+    report = m.reshard(new_mesh={"c": 2, "n": 2}, research=True,
+                       verify="error")
+    assert report["researched"] is True
+    assert {a: s for a, s in m.mesh.sizes.items() if s > 1} == \
+        {"c": 2, "n": 2}
+    for op in m.layers:
+        pc = op.parallel_config
+        assert pc is not None
+        legal = per_dim_degrees(op, dict(m.mesh.sizes))
+        assert all(d in degs for d, degs in zip(pc.dims, legal)), \
+            (op.name, pc.dims, legal)
+    assert np.isfinite(_train(m, 1)[0])
+
+
+def test_search_fixed_mesh_stays_pinned():
+    """mcmc.search(fixed_mesh=...) never leaves the pinned factorization
+    and rejects a pin that contradicts the device count."""
+    from flexflow_tpu.search.mcmc import search
+
+    m = _model({"n": 2})
+    best, best_mesh, t = search(m.layers, 4, budget=16, seed=0,
+                                fixed_mesh={"c": 2, "n": 2})
+    assert {a: s for a, s in best_mesh.items() if s > 1} == \
+        {"c": 2, "n": 2}
+    assert set(best) == {op.name for op in m.layers}
+    assert np.isfinite(t)
+    with pytest.raises(ValueError, match="fixed_mesh"):
+        search(m.layers, 8, budget=4, fixed_mesh={"n": 2})
+
+
+# ----------------------------------------------------------------------
+# fault-injected resharding through the REAL train loop
+# ----------------------------------------------------------------------
+def test_resume_with_research_restores_values(tmp_path):
+    """Reshard-on-resume WITH a search budget: the re-search runs with
+    redistribute=False (sharding templates only — the restore overwrites
+    every value), and the restored params equal the checkpoint exactly."""
+    a = _model({"n": 4})
+    _train(a, 3)
+    ckpt = os.path.join(tmp_path, "researched.npz")
+    a.save_checkpoint(ckpt)
+    want = {k: np.asarray(v) for k, v in a._params.items()}
+
+    b = _model({"n": 2}, budget=8)
+    b.load_checkpoint(ckpt)
+    assert b._step == 3
+    assert all(op.parallel_config is not None for op in b.layers)
+    for k, v in want.items():
+        np.testing.assert_array_equal(np.asarray(b._params[k]), v,
+                                      err_msg=k)
+    assert np.isfinite(_train(b, 1)[0])
+
+
+def test_mismatched_checkpoint_leaves_model_untouched(tmp_path):
+    """A checkpoint from a DIFFERENT model saved on a different mesh
+    fails load_checkpoint with the target model fully intact: the
+    graph/optimizer validation runs BEFORE reshard-on-resume, which
+    would otherwise have zero-filled the params it then cannot
+    restore."""
+    a = _model({"n": 4})
+    _train(a, 1)
+    ckpt = os.path.join(tmp_path, "other.npz")
+    a.save_checkpoint(ckpt)
+
+    cfg = ff.FFConfig(batch_size=BS, compute_dtype="float32")
+    b = ff.FFModel(cfg, mesh=MachineMesh({"n": 2}))
+    x = b.create_tensor((BS, NFEAT), name="x")
+    t = b.dense(x, 48, activation="relu")  # width mismatch vs _model's 32
+    t = b.dense(t, NCLS)
+    b.compile(ff.SGDOptimizer(lr=0.1, momentum=0.9),
+              "sparse_categorical_crossentropy", [], final_tensor=t)
+    b.init_layers(seed=0)
+    before = {k: np.asarray(v) for k, v in b._params.items()}
+    mesh_before = b.mesh
+    with pytest.raises(ValueError, match="does not match"):
+        b.load_checkpoint(ckpt)
+    assert b.mesh is mesh_before  # reshard-on-resume never ran
+    for k, v in before.items():
+        np.testing.assert_array_equal(np.asarray(b._params[k]), v,
+                                      err_msg=k)
+    assert np.isfinite(_train(b, 1)[0])
+
+
+def test_fault_shrink_at_step_parity(tmp_path, fault_env):
+    """FF_FAULT=shrink_at_step:3,devices=2 — the train loop reshards
+    itself after step 3 and the whole 6-step trajectory equals mesh-A
+    steps 1-3 + the fixed-mesh-B reference steps 4-6, bitwise."""
+    pre_ref, post_ref = _mesh_b_reference(tmp_path)
+    fault_env("shrink_at_step:3,devices=2")
+    m = _model({"n": 4})
+    losses = _train(m, 6)
+    assert m.mesh.num_devices == 2
+    assert losses[:3] == pre_ref
+    assert losses[3:] == post_ref
+
+
+def test_fault_grow_at_step_default_doubles(tmp_path, fault_env):
+    """grow_at_step without devices= doubles the mesh (2 -> 4)."""
+    pre_ref, post_ref = _mesh_b_reference(tmp_path, mesh_a={"n": 2},
+                                          mesh_b={"n": 4})
+    fault_env("grow_at_step:3")
+    m = _model({"n": 2})
+    losses = _train(m, 6)
+    assert m.mesh.num_devices == 4
+    assert losses[:3] == pre_ref
+    assert losses[3:] == post_ref
+
+
+def test_fault_reshard_rounds_to_window_edge(fault_env):
+    """Under fused K-step dispatch the reshard lands at the WINDOW edge
+    (mid-window steps never re-enter Python), and the already-prefetched
+    next window — staged under the OLD mesh — is re-placed instead of
+    poisoning the dispatch."""
+    fault_env("shrink_at_step:3,devices=2")
+    cfg = ff.FFConfig(batch_size=BS, compute_dtype="float32")
+    cfg.steps_per_dispatch = 2
+    m = ff.FFModel(cfg, mesh=MachineMesh({"n": 4}))
+    x = m.create_tensor((BS, NFEAT), name="x")
+    t = m.dense(x, 32, activation="relu")
+    t = m.dense(t, NCLS)
+    m.compile(ff.SGDOptimizer(lr=0.1),
+              "sparse_categorical_crossentropy", [], final_tensor=t)
+    m.init_layers(seed=0)
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((BS * 8, NFEAT)).astype(np.float32)
+    yv = rng.integers(0, NCLS, (BS * 8, 1)).astype(np.int32)
+    m.fit(xv, yv, epochs=1, verbose=False)
+    # step 3 rounds up to the step-4 window edge; training finished all
+    # 8 steps on the shrunken mesh
+    assert m.mesh.num_devices == 2
+    assert m._step == 8
+    assert np.all(np.isfinite(m.last_epoch_losses))
+    assert len(m.last_epoch_losses) == 8
+
+
+def test_fault_reshard_in_plain_fit_loop(fault_env):
+    """K=1 fit(): the per-batch prefetch loop also re-places the batch
+    staged under the old mesh when a reshard fires mid-epoch."""
+    fault_env("shrink_at_step:2,devices=2")
+    m = _model({"n": 4})
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((BS * 6, NFEAT)).astype(np.float32)
+    yv = rng.integers(0, NCLS, (BS * 6, 1)).astype(np.int32)
+    m.fit(xv, yv, epochs=1, verbose=False)
+    assert m.mesh.num_devices == 2
+    assert m._step == 6
+    assert np.all(np.isfinite(m.last_epoch_losses))
+
+
+# ----------------------------------------------------------------------
+# pieces
+# ----------------------------------------------------------------------
+def test_scaled_shape_rescales_data_axis():
+    assert scaled_shape({"n": 4}, 2) == {"n": 2}
+    assert scaled_shape({"n": 2, "c": 2}, 8) == {"c": 2, "n": 4}
+    assert scaled_shape({"n": 4}, 1) == {"n": 1}  # never the {} trap
+    with pytest.raises(ValueError, match="does not divide"):
+        scaled_shape({"n": 2, "c": 4}, 6)
+    with pytest.raises(ValueError, match=">= 1"):
+        scaled_shape({"n": 2}, 0)
+
+
+def test_manifest_records_topology(tmp_path):
+    """The v2 manifest carries mesh shape, device/process counts and the
+    strategy digest save-side; manifest_meta normalizes them."""
+    from flexflow_tpu.resilience import manifest_meta, read_npz_verified
+
+    m = _model({"n": 4})
+    _train(m, 1)
+    ckpt = os.path.join(tmp_path, "topo.npz")
+    m.save_checkpoint(ckpt)
+    meta = manifest_meta(read_npz_verified(ckpt))
+    assert meta["format_version"] == 2
+    assert meta["step"] == 1
+    assert meta["mesh_shape"] == {"n": 4}
+    assert meta["num_devices"] == 4
+    assert meta["process_count"] == 1
+    assert meta["strategy_digest"] == m._strategy_digest()
+
+
+def test_strategy_digest_stable_and_order_free():
+    from flexflow_tpu.config import ParallelConfig
+    from flexflow_tpu.strategy.proto import strategy_digest
+
+    pc = ParallelConfig(dims=(4, 1), device_ids=(0, 1, 2, 3))
+    a = strategy_digest({"dense": pc, "dense_1": None})
+    b = strategy_digest({"dense_1": None, "dense": pc})
+    assert a == b
+    assert a != strategy_digest({"dense": pc.with_dims((2, 1)),
+                                 "dense_1": None})
+    assert a != strategy_digest({"dense": pc, "dense_1": pc})

@@ -248,6 +248,73 @@ def test_cancelled_future_does_not_kill_dispatcher():
     assert doomed.cancelled()
 
 
+def test_poisoned_batch_fails_only_its_futures_and_serving_continues(
+        capsys):
+    """A device dispatch that blows up fails THE AFFECTED futures with
+    the error and the engine keeps serving subsequent batches — one
+    poisoned batch must never wedge the queue.  The failure is counted
+    in serve_stats (``errors``) and emitted as a structured
+    ``serve_dispatch_error`` event."""
+    m = _model()
+    eng = ServingEngine(m, stats_every=0)
+    boom = {"armed": True}
+    orig = m.forward_compiled
+
+    def flaky(bucket):
+        if boom["armed"]:
+            boom["armed"] = False
+            raise RuntimeError("injected dispatch failure")
+        return orig(bucket)
+
+    m.forward_compiled = flaky
+    try:
+        # queued before start: both requests coalesce into the ONE
+        # poisoned dispatch
+        doomed = [eng.submit(r) for r in _requests([3, 4], seed=5)]
+        eng.start()
+        errs = [pytest.raises(RuntimeError, f.result, timeout=30)
+                for f in doomed]
+        assert all("injected dispatch failure" in str(e.value)
+                   for e in errs)
+        # the dispatcher survived: the next batch serves correctly
+        after_req = _requests([5], seed=6)[0]
+        after = eng.submit(after_req).result(timeout=30)
+    finally:
+        m.forward_compiled = orig
+        eng.stop()
+    np.testing.assert_array_equal(
+        after, m.predict(after_req, batch_size=BS)[:5])
+    snap = eng.stats()
+    assert snap["errors"] == 2          # logical requests, not chunks
+    assert snap["requests"] == 1        # only the successful one
+    events = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+              if l.startswith("{")]
+    derr = [e for e in events if e["event"] == "serve_dispatch_error"]
+    assert len(derr) == 1
+    assert derr[0]["failed_requests"] == 2
+    assert "injected dispatch failure" in derr[0]["error"]
+    assert derr[0]["errors_total"] == 2
+
+
+def test_engine_serves_across_reshard():
+    """Serving survives a live mesh change: reshard() drops the AOT
+    bucket executables, and the dispatcher — which looks executables up
+    through the model's cache — re-lowers for the new mesh on the next
+    packed batch, still bit-identical to predict()."""
+    m = _model({"n": 4})
+    req_a, req_b = _requests([6, 9], seed=7)
+    with ServingEngine(m, stats_every=0) as eng:
+        before = eng.submit(req_a).result(timeout=60)
+        np.testing.assert_array_equal(
+            before, m.predict(req_a, batch_size=BS)[:6])
+        m.reshard(new_mesh={"n": 2})
+        assert m._fwd_compiled == {}    # stale executables dropped
+        after = eng.submit(req_b).result(timeout=60)
+    np.testing.assert_array_equal(
+        after, m.predict(req_b, batch_size=BS)[:9])
+    assert eng.stats()["errors"] == 0
+
+
 def test_submit_copies_caller_buffer():
     """submit() returns while the rows are still queued — the engine
     must own a copy so a client reusing its buffer cannot mutate an

@@ -191,6 +191,35 @@ def test_static_checks_script_passes_on_repo():
      "        for r in reqs:\n"
      "            r.set_result(float(r.x))\n",
      None),
+    # RL006: raw jax meshes outside parallel/mesh.py bypass the
+    # reshard-aware MachineMesh factory (ISSUE 6)
+    ("flexflow_tpu/zz_bad_mesh.py",
+     "from jax.sharding import Mesh\n\n"
+     "def f(devs):\n"
+     "    return Mesh(devs, ('x',))\n",
+     "RL006"),
+    ("flexflow_tpu/serving/zz_bad_make_mesh.py",
+     "import jax\n\n"
+     "def f():\n"
+     "    return jax.make_mesh((2,), ('n',))\n",
+     "RL006"),
+    # the factory itself is the sanctioned construction site
+    ("flexflow_tpu/parallel/mesh.py",
+     "from jax.sharding import Mesh\n\n"
+     "def build(devs):\n"
+     "    return Mesh(devs, ('n0',))\n",
+     None),
+    # MachineMesh use and test-side raw meshes are fine
+    ("flexflow_tpu/zz_ok_machinemesh.py",
+     "from flexflow_tpu.parallel.mesh import MachineMesh\n\n"
+     "def f():\n"
+     "    return MachineMesh({'n': 2})\n",
+     None),
+    ("tests/zz_ok_raw_mesh.py",
+     "from jax.sharding import Mesh\n\n"
+     "def f(devs):\n"
+     "    return Mesh(devs, ('x',))\n",
+     None),
 ])
 def test_repo_lint_rules(tmp_path, rel, src, code):
     """repo_lint unit check on synthetic files, laid out under tmp_path
