@@ -64,25 +64,32 @@ def memory_diagnostics(layers: List[Op],
                        strategies: Dict[str, ParallelConfig],
                        mesh_shape: MeshShape, num_devices: int,
                        spec=None, opt_slot_bytes: int = 4,
-                       sparse_tables=frozenset()) -> List[Diagnostic]:
+                       sparse_tables=frozenset(),
+                       xla_temp_factor: Optional[float] = None
+                       ) -> List[Diagnostic]:
     """FF108 — per-device peak memory vs the HBM budget, through the SAME
     accounting the search's legality check uses (Simulator.peak_memory_bytes
     x the calibrated XLA_TEMP_FACTOR): a strategy lint passes must not be
-    one the search would score inf, and vice versa."""
+    one the search would score inf, and vice versa.  ``xla_temp_factor``
+    overrides the built-in compiler-temp factor with a machine-measured
+    one (a CalibrationTable's ``xla_temp_factor`` via
+    ``flexflow-tpu lint --calibration``)."""
     from ..search.cost_model import XLA_TEMP_FACTOR, spec_for_device
     from ..search.simulator import Simulator
 
     spec = spec or spec_for_device()
+    factor = (float(xla_temp_factor) if xla_temp_factor
+              else XLA_TEMP_FACTOR)
     sim = Simulator(spec=spec, num_devices=max(1, num_devices),
                     use_native=False, opt_slot_bytes=opt_slot_bytes,
                     sparse_tables=sparse_tables)
     peak = sim.peak_memory_bytes(layers, strategies, mesh_shape,
-                                 assume_remat=False) * XLA_TEMP_FACTOR
+                                 assume_remat=False) * factor
     if peak > spec.hbm_capacity:
         return [make(
             "FF108", "",
             f"estimated per-device peak {peak / 1e9:.2f} GB (incl. "
-            f"{XLA_TEMP_FACTOR}x compiler-temp factor) exceeds the "
+            f"{factor}x compiler-temp factor) exceeds the "
             f"{spec.hbm_capacity / 1e9:.1f} GB HBM budget; the search "
             f"scores this strategy infeasible (inf)",
             hint="raise the sharding degrees, shard the optimizer, or "

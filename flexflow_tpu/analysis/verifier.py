@@ -31,6 +31,7 @@ def verify(layers: List[Op],
            parameters: Iterable = (),
            spec=None, opt_slot_bytes: int = 4,
            sparse_tables=frozenset(),
+           xla_temp_factor: Optional[float] = None,
            check_memory: bool = True,
            check_resharding: bool = True) -> DiagnosticReport:
     """Static verification of a graph + strategy.
@@ -88,7 +89,8 @@ def verify(layers: List[Op],
     if check_memory:
         report.extend(memory_diagnostics(
             layers, strategies, mesh_shape, num_devices, spec=spec,
-            opt_slot_bytes=opt_slot_bytes, sparse_tables=sparse_tables))
+            opt_slot_bytes=opt_slot_bytes, sparse_tables=sparse_tables,
+            xla_temp_factor=xla_temp_factor))
     if check_resharding:
         report.extend(resharding_diagnostics(layers, strategies,
                                              num_devices))

@@ -40,6 +40,16 @@ def main(argv=None) -> None:
         from .serving.bench import main as serve_bench_main
         serve_bench_main(argv[1:])
         return
+    if argv and argv[0] == "calibrate":
+        # harvest measured op/dispatch timings into a CalibrationTable,
+        # or --check existing artifacts (docs/strategy_search.md)
+        from .search.calibration import calibrate_main
+        raise SystemExit(calibrate_main(argv[1:]))
+    if argv and argv[0] == "calibrate-bench":
+        # sim-vs-measured MAPE sweep, analytic vs calibrated estimators
+        # (docs/performance.md "Calibration")
+        from .search.calibration import calibrate_bench_main
+        raise SystemExit(calibrate_bench_main(argv[1:]))
     if argv and argv[0] == "elastic":
         # supervised multi-process training with restart-from-checkpoint
         # (docs/elastic.md)
@@ -59,12 +69,17 @@ def main(argv=None) -> None:
               "       flexflow-tpu search-bench [flags]\n"
               "       flexflow-tpu train-bench [flags]\n"
               "       flexflow-tpu serve-bench [flags]\n"
+              "       flexflow-tpu calibrate [--out table.json | "
+              "--check FILE...]\n"
+              "       flexflow-tpu calibrate-bench --table table.json "
+              "[--out report.json]\n"
               "       flexflow-tpu lint --model NAME [--strategy s.pb] "
               "[--devices N] [--json]\n"
               "flags (reference model.cc:1221-1289): -e -b --lr --wd -d "
               "--budget --alpha --reshard-budget -s/-import -ll:tpu "
               "-ll:cpu --nodes --profiling --seed --remat "
-              "--steps-per-dispatch --pad-tail "
+              "--steps-per-dispatch --pad-tail --calibration "
+              "--cost-estimator "
               "--serve-max-batch --serve-max-wait-ms --serve-buckets",
               file=sys.stderr)
         raise SystemExit(2)
@@ -134,6 +149,13 @@ def lint_main(argv) -> int:
     parser.add_argument("--hbm-gb", type=float, default=0.0,
                         help="per-chip HBM budget override in GB "
                              "(default: attached/assumed device spec)")
+    parser.add_argument("--calibration", default="",
+                        help="CalibrationTable JSON (flexflow-tpu "
+                             "calibrate): applies its measured "
+                             "DeviceSpec overrides and xla_temp_factor "
+                             "to the FF108 HBM pass, so lint judges "
+                             "the same calibrated budget the search "
+                             "does (docs/strategy_search.md)")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable report on stdout")
     parser.add_argument("--no-resharding", action="store_true",
@@ -170,11 +192,22 @@ def lint_main(argv) -> int:
             return 2
 
     spec = None
+    temp_factor = None
+    if args.calibration:
+        from .search.calibration import CalibrationTable, calibrated_spec
+        try:
+            table = CalibrationTable.load(args.calibration)
+        except (OSError, ValueError) as e:
+            print(f"lint: cannot load {args.calibration}: {e}",
+                  file=sys.stderr)
+            return 2
+        spec = calibrated_spec(table)
+        temp_factor = table.xla_temp_factor
     if args.hbm_gb > 0:
         import dataclasses
 
         from .search.cost_model import spec_for_device
-        spec = dataclasses.replace(spec_for_device(),
+        spec = dataclasses.replace(spec or spec_for_device(),
                                    hbm_capacity=args.hbm_gb * 1e9)
 
     from .analysis import verify
@@ -184,6 +217,7 @@ def lint_main(argv) -> int:
         input_tensors=model.input_tensors,
         final_tensors=model.layers[-1].outputs if model.layers else (),
         parameters=model.parameters, spec=spec,
+        xla_temp_factor=temp_factor,
         check_resharding=not args.no_resharding)
     print(report.render_json() if args.json else report.render_text())
     return 1 if report.errors else 0

@@ -141,6 +141,18 @@ class FFConfig:
     param_dtype: str = "float32"
     mesh_shape: Optional[Dict[str, int]] = None  # explicit mesh override
     simulator_mode: str = "analytic"  # "analytic" | "measure"
+    # Profile-calibrated cost model (search/calibration.py,
+    # docs/strategy_search.md "Calibration").  calibration_file points at
+    # a CalibrationTable JSON harvested by `flexflow-tpu calibrate`;
+    # cost_estimator picks the per-op time model the simulator searches
+    # with: "analytic" (the raw roofline), "table" (roofline rescaled by
+    # measured/analytic ratios), "ridge" (learned regression over op
+    # features, arXiv 2008.01040), or "auto" (= "table" when a file is
+    # set, "analytic" otherwise).  With no file and the default "auto",
+    # nothing is loaded and every simulator output is bit-identical to
+    # an uncalibrated build.
+    calibration_file: str = ""
+    cost_estimator: str = "auto"  # auto | analytic | table | ridge
     remat: bool = False  # jax.checkpoint the forward pass
     # internal conv/pool layout: "nchw" (reference parity), "nhwc"
     # (channels-minor = TPU lane dim), or "auto" (currently nchw until the
@@ -274,6 +286,10 @@ class FFConfig:
                 cfg.search_chains = max(1, int(val()))
             elif a == "--reshard-budget":
                 cfg.reshard_search_budget = int(val())
+            elif a == "--calibration":
+                cfg.calibration_file = val()
+            elif a == "--cost-estimator":
+                cfg.cost_estimator = val().lower()
             elif a == "--overlap":
                 cfg.search_overlap_backward_update = True
             elif a in ("-s", "--export"):

@@ -64,16 +64,51 @@ class Category:
 
     def event(self, event: str, **fields: Any) -> None:
         """One JSON line per event on stdout (info level): the structured
-        metrics stream (e.g. one line per training epoch from fit())."""
-        if _LEVELS["info"] < self.level:
-            return
+        metrics stream (e.g. one line per training epoch from fit()).
+        Active :func:`capture_events` contexts receive the record dict
+        regardless of level — a harness harvesting events (e.g.
+        ``flexflow-tpu calibrate`` reading fit()'s ``dispatch_ms``) must
+        see them even while the stdout stream is silenced."""
         rec: Dict[str, Any] = {"cat": self.name, "event": event,
                                "t": round(time.time(), 3)}
         rec.update(fields)
+        muted = False
+        for names, sink, mute in _captures:
+            if names is None or self.name in names:
+                sink.append(dict(rec))
+                muted = muted or mute
+        if muted or _LEVELS["info"] < self.level:
+            return
         print(json.dumps(rec), flush=True)
 
 
 _registry: Dict[str, Category] = {}
+# active capture_events contexts: (category-name filter | None, sink, mute)
+_captures: list = []
+
+
+@contextlib.contextmanager
+def capture_events(*names: str, mute: bool = True):
+    """Record every ``Category.event`` dict emitted by the given
+    categories (all categories when none given) into the yielded list —
+    the programmatic consumer of the event stream (``flexflow-tpu
+    calibrate`` harvests fit()'s per-dispatch ``dispatch_ms`` this way).
+    ``mute=True`` (default) suppresses the captured events' stdout lines
+    so a harness's JSON payload cannot interleave with them; capture
+    works even under :func:`silenced` (it hooks before the level gate)."""
+    sink: list = []
+    entry = (frozenset(names) or None, sink, mute)
+    _captures.append(entry)
+    try:
+        yield sink
+    finally:
+        # remove by identity, not equality: two nested captures with the
+        # same filter compare equal once their sinks hold equal events,
+        # and list.remove() would pop the OUTER entry
+        for i in range(len(_captures) - 1, -1, -1):
+            if _captures[i] is entry:
+                del _captures[i]
+                break
 
 
 def get_logger(name: str) -> Category:

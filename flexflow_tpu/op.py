@@ -210,7 +210,8 @@ class Op:
         outputs (default none).  The roofline only sees boundary tensors;
         ops that materialize large internals (dense attention's f32 score
         matrix, batchnorm's f32 stats passes) override this — calibrated
-        against on-chip measurements (scripts/calibrate_cost_model.py).
+        against on-chip measurements (``flexflow-tpu calibrate``; the
+        round-5 record is seed data in search/calibration_seed.json).
         ``flash_attention`` is the run's configured kernel-selection flag
         (FFConfig.flash_attention), forwarded by the cost model so ops
         whose internal traffic depends on which kernel actually runs

@@ -328,23 +328,24 @@ class Conv2D(Op):
 
     def mxu_efficiency(self):
         # the MXU reduces over in_channels x kernel window; C_in < 8
-        # can't fill the reduction lanes (stem conv: measured 0.63ms vs
-        # 0.30ms ideal at C_in=3, scripts/calibrate_cost_model.py)
+        # can't fill the reduction lanes (round-5 stem-conv measurement,
+        # now seed data: search/calibration_seed.json conv7x7_s2 row)
         return min(1.0, self.in_channels / 8.0)
 
     def backward_overhead(self, part_degrees=None):
         # strided dgrad lowers to a conv over the interior-dilated
         # gradient, whose MAC waste grows ~s*s (the dilated input is
-        # s*s larger with the same nonzero count).  r5 calibration,
-        # conv7x7/s2 row: analytic fwd 0.411 + bwd 0.820 = 1.231 ms vs
-        # measured 3.155 ms with fwd alone matching (0.371) -> measured
-        # bwd 2.78 ms = 3.4x the 2x-forward model.  Anchoring the s*s
-        # law at that point: overhead(s) = 1 + 2.4 * s*s / 4, so s=2
-        # reproduces the measured 3.4x and stride-3+ convs scale instead
-        # of reusing one constant (ADVICE r5: a flat 3.4x mis-costs
-        # stride-3/tiny-kernel convs in analytic search mode).  Stride-1
-        # conv rows match the 2x-forward model (1.06-1.12x), no
-        # correction.  Deliberately does NOT consult _use_fast_dgrad():
+        # s*s larger with the same nonzero count).  The anchor point is
+        # the round-5 conv7x7/s2 measurement — seed CalibrationTable,
+        # search/calibration_seed.json, conv2d|128x64x128x128 row: the
+        # measured bwd is 3.4x the 2x-forward model while fwd alone
+        # matches.  Anchoring the s*s law there: overhead(s) = 1 +
+        # 2.4 * s*s / 4, so s=2 reproduces the measured 3.4x and
+        # stride-3+ convs scale instead of reusing one constant (ADVICE
+        # r5: a flat 3.4x mis-costs stride-3/tiny-kernel convs in
+        # analytic search mode).  The seed table's stride-1 conv rows
+        # match the 2x-forward model (1.06-1.12x), no correction.
+        # Deliberately does NOT consult _use_fast_dgrad():
         # the tuned table never ships fast_dgrad on TPU (microbench: the
         # phase decomposition is 2.6x slower than the dilated lowering
         # there), and on the CPU test backend these TPU-calibrated
@@ -501,10 +502,11 @@ class Pool2D(Op):
         return self.outputs[0].volume * self.kernel[0] * self.kernel[1]
 
     def backward_overhead(self, part_degrees=None):
-        # max-pool backward lowers to SelectAndScatter: r5 calibration
-        # measured the pool2x2 row at 1.9x its bandwidth roofline
-        # (BASELINE.md); avg-pool backward is a plain dilated sum, on
-        # roofline.  The overhead is gone only when the Pallas tile
+        # max-pool backward lowers to SelectAndScatter: the round-5
+        # pool2x2 measurement (seed CalibrationTable,
+        # search/calibration_seed.json pool2d row) put it at 1.9x its
+        # bandwidth roofline; avg-pool backward is a plain dilated sum,
+        # on roofline.  The overhead is gone only when the Pallas tile
         # kernel would actually run: tuned ON for this device kind,
         # shape/window inside the kernel's support envelope (layout
         # approximated as NHWC — the library's TPU auto for pool-heavy

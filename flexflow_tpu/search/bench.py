@@ -82,14 +82,19 @@ def _proposal_sequence(layers, num_devices: int, steps: int, seed: int
 
 def bench_graph(name: str, num_devices: int = 16, steps: int = 192,
                 budget: int = 200, seed: int = 0,
-                min_time_s: float = 0.4) -> Dict:
-    """Delta-vs-full proposals/sec + best simulated time for one graph."""
+                min_time_s: float = 0.4, estimator=None) -> Dict:
+    """Delta-vs-full proposals/sec + best simulated time for one graph.
+    ``estimator`` (a ``search.calibration.CostEstimator``) makes both
+    paths — and the short real search — run on the calibrated
+    objective; the row records which estimator/calibration produced it
+    so artifacts stay comparable across machines and calibration
+    states."""
     from ..profiling import time_calls
     from .mcmc import search
     from .simulator import Simulator
 
     layers = GRAPHS[name]()
-    sim = Simulator(num_devices=num_devices)
+    sim = Simulator(num_devices=num_devices, estimator=estimator)
     seq = _proposal_sequence(layers, num_devices, steps, seed)
 
     # warm the shared plan cache (and the one-shot path) so both timed
@@ -114,11 +119,16 @@ def bench_graph(name: str, num_devices: int = 16, steps: int = 192,
     session.close()
 
     best, best_mesh, best_t = search(layers, num_devices, budget=budget,
-                                     seed=seed)
+                                     seed=seed, sim=sim)
+    from .calibration import device_kind as _device_kind
+    desc = (estimator.describe() if estimator is not None
+            else {"estimator": "analytic", "calibration_digest": None})
     return {
         "graph": name,
         "num_ops": len(layers),
         "num_devices": num_devices,
+        "device_kind": _device_kind(),
+        **desc,
         "proposal_steps": steps,
         "proposals_per_sec_full": round(full_cps * steps, 2),
         "proposals_per_sec_delta": round(delta_cps * steps, 2),
@@ -148,6 +158,13 @@ def main(argv=None) -> None:
                          + ",".join(GRAPHS))
     ap.add_argument("--min-time", type=float, default=0.4,
                     help="seconds of wall clock per timed loop")
+    ap.add_argument("--calibration", default="",
+                    help="CalibrationTable JSON — bench the CALIBRATED "
+                         "objective (docs/strategy_search.md "
+                         "'Calibration')")
+    ap.add_argument("--estimator", default="",
+                    help="cost estimator (table|ridge; default table "
+                         "when --calibration is given, else analytic)")
     ap.add_argument("--out", default="",
                     help="also write the JSON artifact here")
     args = ap.parse_args(argv)
@@ -155,9 +172,23 @@ def main(argv=None) -> None:
     for g in names:
         if g not in GRAPHS:
             ap.error(f"unknown graph {g!r}; choose from {sorted(GRAPHS)}")
+    if args.estimator not in ("", "analytic", "table", "ridge"):
+        ap.error(f"unknown estimator {args.estimator!r}; choose from "
+                 "analytic, table, ridge")
+    if args.estimator in ("table", "ridge") and not args.calibration:
+        ap.error(f"--estimator {args.estimator} needs --calibration "
+                 "(a table from flexflow-tpu calibrate)")
+    estimator = None
+    if args.calibration or args.estimator not in ("", "analytic"):
+        from .calibration import CalibrationTable, make_estimator
+        table = (CalibrationTable.load(args.calibration)
+                 if args.calibration else None)
+        estimator = make_estimator(args.estimator
+                                   or ("table" if table else "analytic"),
+                                   table)
     results = [bench_graph(g, num_devices=args.devices, steps=args.steps,
                            budget=args.budget, seed=args.seed,
-                           min_time_s=args.min_time)
+                           min_time_s=args.min_time, estimator=estimator)
                for g in names]
     payload = {"bench": "search-bench", "results": results}
     text = json.dumps(payload, indent=2)

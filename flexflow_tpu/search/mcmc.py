@@ -174,6 +174,7 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
            devices_per_slice=_UNSET, remat=_UNSET,
            compute_dtype=_UNSET, conv_layout=_UNSET,
            opt_slot_bytes=_UNSET, sparse_tables=_UNSET,
+           estimator=_UNSET,
            sim: Optional[Simulator] = None, chains: int = 1,
            fixed_mesh: Optional[MeshShape] = None
            ) -> Tuple[Dict[str, ParallelConfig], MeshShape, float]:
@@ -207,7 +208,8 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
                ("compute_dtype", compute_dtype),
                ("conv_layout", conv_layout),
                ("opt_slot_bytes", opt_slot_bytes),
-               ("sparse_tables", sparse_tables))
+               ("sparse_tables", sparse_tables),
+               ("estimator", estimator))
     if sim is not None:
         # the shared sim's config IS the objective; contradicting kwargs
         # would silently split seed-ranking from the acceptance test
@@ -226,12 +228,19 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
         # on agreeing calls
         _norm = {"spec": lambda v: spec_for_device() if v is None else v,
                  "devices_per_slice": lambda v: v or num_devices,
-                 "sparse_tables": lambda v: frozenset(v or ())}
+                 "sparse_tables": lambda v: frozenset(v or ()),
+                 # estimators compare by describe(): kind AND calibration
+                 # digest — two TableEstimators over different tables are
+                 # different objectives, same-name comparison would let a
+                 # stale shared-sim table silently win
+                 "estimator": lambda v: (None if v is None else
+                                         tuple(sorted(v.describe().items())))}
         for _name, _given in _kwargs:
             if _given is _UNSET:
                 continue
-            _given = _norm.get(_name, lambda v: v)(_given)
-            _sims = getattr(sim, _name)
+            _n = _norm.get(_name, lambda v: v)
+            _given = _n(_given)
+            _sims = _n(getattr(sim, _name))
             if _given != _sims:
                 warnings.warn(
                     f"search(sim=...) ignores {_name}={_given!r}; the "
@@ -300,7 +309,7 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
         devices_per_slice=devices_per_slice, remat=remat,
         flash_attention=flash_attention, compute_dtype=compute_dtype,
         conv_layout=conv_layout, opt_slot_bytes=opt_slot_bytes,
-        sparse_tables=sim.sparse_tables)
+        sparse_tables=sim.sparse_tables, estimator=sim.estimator)
     seed_cache: Dict[Tuple[int, ...], List] = {}
 
     def mesh_seeds(ms: MeshShape) -> List:
@@ -453,6 +462,25 @@ def optimize_strategies(model, cfg: FFConfig, num_devices: int = None,
     # costing (ADVICE r5: hetero candidates would otherwise be scored
     # with the cheap sparse row-grad sync they can't actually use)
     sparse_tables = {t for _, t, _ in model._sparse_embedding_specs()}
+    # profile-calibrated objective (docs/strategy_search.md
+    # "Calibration"): cfg.calibration_file + cfg.cost_estimator resolve
+    # to a CostEstimator (and a comm-calibrated DeviceSpec when the
+    # table carries measured bandwidth overrides).  estimator_from_config
+    # returns (None, None) for the uncalibrated default, in which case
+    # nothing below changes and the search is bit-identical to an
+    # uncalibrated build.
+    extra = {}
+    from .calibration import calibrated_spec, estimator_from_config
+    est, calib_table = estimator_from_config(cfg)
+    if est is not None:
+        extra["estimator"] = est
+        # spec overrides ride WITH a calibrated estimator only: an
+        # explicit --cost-estimator analytic is the documented raw
+        # roofline, bit-for-bit (docs/strategy_search.md) — rescaling
+        # its comm costs from the table would silently change the
+        # objective while the [search] line cites no calibration.
+        if calib_table is not None and calib_table.spec:
+            extra["spec"] = calibrated_spec(calib_table)
     best, best_mesh, best_time = search(
         model.layers, ndev,
         budget=cfg.search_budget if budget is None else int(budget),
@@ -463,10 +491,13 @@ def optimize_strategies(model, cfg: FFConfig, num_devices: int = None,
         devices_per_slice=dps, remat=cfg.remat,
         compute_dtype=cfg.compute_dtype, conv_layout=layout,
         opt_slot_bytes=slot_bytes, sparse_tables=sparse_tables,
-        chains=cfg.search_chains, fixed_mesh=mesh_shape)
+        chains=cfg.search_chains, fixed_mesh=mesh_shape, **extra)
+    calib_note = (f", estimator {est.name} "
+                  f"(calibration {calib_table.digest})"
+                  if est is not None and calib_table is not None else "")
     print(f"[search] best simulated iteration time: {best_time * 1e3:.3f} ms "
           f"on {ndev} devices, mesh "
-          f"{ {a: s for a, s in best_mesh.items() if s > 1} }")
+          f"{ {a: s for a, s in best_mesh.items() if s > 1} }{calib_note}")
     if cfg.mesh_shape is None and num_devices is None:
         cfg.mesh_shape = {a: s for a, s in best_mesh.items() if s > 1}
     return (best, best_mesh) if with_mesh else best

@@ -80,7 +80,7 @@ class Simulator:
                  use_native: bool = True, flash_attention=None,
                  remat: bool = False, compute_dtype: str = "bfloat16",
                  conv_layout: str = "auto", opt_slot_bytes: int = 4,
-                 sparse_tables=None):
+                 sparse_tables=None, estimator=None):
         self.spec = spec if spec is not None else spec_for_device()
         self.num_devices = num_devices
         self.devices_per_slice = devices_per_slice or num_devices
@@ -96,6 +96,14 @@ class Simulator:
         # the touched ROW gradients, not the table — dense-path costing
         # would overestimate DLRM/NMT-class sync by orders of magnitude
         self.sparse_tables = frozenset(sparse_tables or ())
+        # pluggable per-op time model (search/calibration.py): a
+        # profile-calibrated CostEstimator rescales (table) or replaces
+        # (ridge) the analytic roofline.  None — the default — keeps the
+        # raw op_compute_time path untouched, so uncalibrated runs are
+        # bit-identical to a build without calibration.  The SimSession
+        # and the native engine consume this simulator's _op_plan times,
+        # so one estimator covers every simulation path.
+        self.estimator = estimator
         self.flash_attention = flash_attention  # measure the run's kernels
         self.remat = remat  # the run rematerializes: less resident memory
         self.compute_dtype = compute_dtype  # measure the run's dtype
@@ -126,6 +134,11 @@ class Simulator:
                           f"compile)", flush=True)
             fwd, bwd = self._measure_cache[key]
             return bwd if backward else fwd
+        if self.estimator is not None:
+            return self.estimator.op_time(
+                op, dims, self.spec, self.dtype_bytes, backward,
+                flash_attention=self.flash_attention,
+                compute_dtype=self.compute_dtype)
         return op_compute_time(op, dims, self.spec, self.dtype_bytes, backward,
                                flash_attention=self.flash_attention)
 

@@ -237,9 +237,12 @@ def run_serve_bench(requests: int = 512, rows_lo: int = 1, rows_hi: int = 8,
     n_paced = min(n_paced, int(rate * 4) + 1)
     paced_row = _run_paced(model, reqs[:n_paced], rate, burst, seed)
 
+    from ..search.calibration import device_kind as _device_kind
     return {
         "bench": "serve-bench",
         "backend": jax.default_backend(),
+        "device_kind": _device_kind(),
+        "estimator": "measured",  # real engine run, not a sim estimate
         "config": {
             "requests": requests, "rows": f"{rows_lo}-{rows_hi}",
             "max_batch": max_batch, "max_wait_ms": max_wait_ms,
@@ -276,6 +279,11 @@ def main(argv=None) -> None:
                          "engine capacity")
     ap.add_argument("--hidden", type=int, default=64)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--calibration", default="",
+                    help="CalibrationTable JSON whose digest the "
+                         "payload records (comparability across "
+                         "machines/calibration states; does not alter "
+                         "the measured run)")
     ap.add_argument("--out", default="",
                     help="also write the JSON artifact here")
     args = ap.parse_args(argv)
@@ -285,6 +293,16 @@ def main(argv=None) -> None:
         ap.error(f"--rows wants LO-HI, got {args.rows!r}")
     if not (1 <= lo <= hi):
         ap.error(f"--rows wants 1 <= LO <= HI, got {args.rows!r}")
+    # resolve the provenance digest BEFORE the measured run — a typo'd
+    # --calibration must fail in milliseconds, not after the whole
+    # engine/naive/paced sweep whose payload it would discard
+    digest = None
+    if args.calibration:
+        from ..search.calibration import CalibrationTable
+        try:
+            digest = CalibrationTable.load(args.calibration).digest
+        except (OSError, ValueError) as e:
+            ap.error(f"cannot load --calibration {args.calibration!r}: {e}")
 
     # this bench's stdout IS the payload: silence the serve_stats /
     # epoch event streams while measuring (restored after)
@@ -295,6 +313,7 @@ def main(argv=None) -> None:
             max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
             buckets=args.buckets, hidden=args.hidden, seed=args.seed,
             burst=args.burst, rate_frac=args.rate_frac)
+    payload["calibration_digest"] = digest
     text = json.dumps(payload, indent=2)
     print(text)
     if args.out:

@@ -88,7 +88,12 @@ class ServingMetrics:
         batches — 1.0 means every dispatch ran a full bucket),
         ``queue_depth`` (at the last dispatch), ``dispatch_ms`` (mean
         device dispatch+fetch wall time) and nearest-rank latency
-        percentiles in ms."""
+        percentiles in ms.  ``per_bucket`` breaks the dispatch wall
+        times down by shape bucket (p50/p95/p99 + counts per bucket):
+        a global mean hides which executables are slow, and the
+        per-shape-bucket medians are exactly what the calibration
+        harvest (``flexflow_tpu.search.calibration
+        .harvest_serve_dispatch``) feeds back into the cost model."""
         now = self.clock()
         with self._lock:
             self._trim(now)
@@ -115,6 +120,21 @@ class ServingMetrics:
             # for any strict consumer when the latency window is empty
             return None if v != v else round(v * 1e3, 3)
 
+        by_bucket: Dict[int, list] = {}
+        for d in disp:
+            by_bucket.setdefault(d[2], []).append(d)
+        per_bucket = {}
+        for b in sorted(by_bucket):
+            rows_b = by_bucket[b]
+            qb = quantiles([d[4] for d in rows_b])
+            per_bucket[str(b)] = {
+                "dispatches": len(rows_b),
+                "rows": sum(d[1] for d in rows_b),
+                "dispatch_p50_ms": ms(qb[0.5]),
+                "dispatch_p95_ms": ms(qb[0.95]),
+                "dispatch_p99_ms": ms(qb[0.99]),
+            }
+
         return {
             "qps": round(len(lats) / req_span, 3),
             "rows_per_sec": round(rows / span, 3),
@@ -126,6 +146,7 @@ class ServingMetrics:
             "p50_ms": ms(q[0.5]),
             "p95_ms": ms(q[0.95]),
             "p99_ms": ms(q[0.99]),
+            "per_bucket": per_bucket,
             "dispatches": totals[0],
             "requests": totals[1],
             "rows": totals[2],

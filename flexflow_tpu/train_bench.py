@@ -101,12 +101,28 @@ def main(argv=None) -> None:
                     help="timed epochs per K (one warm epoch on top)")
     ap.add_argument("--hidden", type=int, default=64)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--calibration", default="",
+                    help="CalibrationTable JSON whose digest the rows "
+                         "record (comparability across machines and "
+                         "calibration states; the table does not alter "
+                         "the measured run)")
     ap.add_argument("--out", default="",
                     help="also write the JSON artifact here")
     args = ap.parse_args(argv)
     ks = [int(v) for v in args.ks.split(",") if v.strip()]
     if any(v < 1 for v in ks):
         ap.error(f"--ks values must be >= 1, got {ks}")
+
+    # resolve the provenance digest BEFORE the measured run — a typo'd
+    # --calibration must fail in milliseconds, not after minutes of
+    # timed epochs whose results it would discard
+    from .search.calibration import (CalibrationTable,
+                                     device_kind as _device_kind)
+    try:
+        digest = (CalibrationTable.load(args.calibration).digest
+                  if args.calibration else None)
+    except (OSError, ValueError) as e:
+        ap.error(f"cannot load --calibration {args.calibration!r}: {e}")
 
     # silence the per-epoch JSON events while benching: this bench's
     # stdout IS the payload, and the event stream would interleave with
@@ -126,13 +142,23 @@ def main(argv=None) -> None:
         log.level = prev_level
     base = next((r for r in results if r["steps_per_dispatch"] == 1),
                 results[0])
+    # provenance stamped on every row (shared with search-bench /
+    # serve-bench): which chip measured this, and under which
+    # calibration state — rows from different machines/tables must
+    # never be compared as if they were one population
+    kind = _device_kind()
     for r in results:
         r["speedup_vs_k1"] = round(
             r["steps_per_sec"] / base["steps_per_sec"], 3)
+        r["device_kind"] = kind
+        r["calibration_digest"] = digest
+        r["estimator"] = "measured"  # real run, not a simulator estimate
     payload = {
         "bench": "train-bench",
         "backend": jax.default_backend(),
         "steps_per_epoch": args.steps,
+        "device_kind": kind,
+        "calibration_digest": digest,
         "results": results,
     }
     text = json.dumps(payload, indent=2)

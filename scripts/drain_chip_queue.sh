@@ -10,7 +10,7 @@ run() {
   timeout "${STEP_TIMEOUT:-1500}" "$@" > "$R/$name.log" 2>&1
   echo "=== $name : rc=$? : end $(date +%T) ===" | tee -a $R/drain.log
 }
-run calibrate       python scripts/calibrate_cost_model.py
+run calibrate       python -m flexflow_tpu.cli calibrate --out "$R/calib_table.json"
 run bottleneck_inc  python scripts/model_bottleneck.py --model inception_v3
 run flash_off       python bench.py --model transformer --flash off
 run flash_on        python bench.py --model transformer --flash on

@@ -34,6 +34,15 @@ by tier-1 ``tests/test_static_checks.py``).  Rules:
   working until the first ``reshard()``/resume-on-new-mesh, then
   silently disagree with the model's placement.  Tests may build raw
   meshes (they pin jax-level behavior).
+* **RL007 — no hard-coded timing/bandwidth constants in op or search
+  code**: a numeric literal in the hardware-rate band (1e8..1e16 —
+  bytes/s, FLOP/s) inside ``flexflow_tpu/ops/`` or
+  ``flexflow_tpu/search/`` is a fossilized calibration number the
+  profile-calibrated cost model (ISSUE 7) exists to replace.  Rate
+  constants live in ``search/cost_model.py`` (``DeviceSpec``) or the
+  CalibrationTable (``search/calibration.py``) — both files exempt;
+  the rare legitimate site elsewhere carries an ``RL007-ok:`` comment
+  on the same line explaining why.
 * **RL005 — no per-request host syncs in the serving dispatch path**
   (the serve-side mirror of RL004, ISSUE 5): inside the dispatch
   functions of ``flexflow_tpu/serving/`` (``_dispatch_loop`` /
@@ -92,11 +101,26 @@ _RL004_FUNCS = ("fit", "evaluate", "predict")
 _RL005_FUNCS = ("_dispatch_loop", "_dispatch_batch")
 
 
+# files where hardware-rate literals are the DESIGN (the device model
+# and the calibration table) — exempt from RL007
+_RL007_EXEMPT = ("flexflow_tpu/search/cost_model.py",
+                 "flexflow_tpu/search/calibration.py")
+# the bytes/s-to-FLOP/s magnitude band RL007 polices (ici/dcn/hbm
+# bandwidths are 1e9-1e12, MXU flops ~1e14; sentinels like 1e29 and
+# epsilons are far outside)
+_RL007_LO, _RL007_HI = 1e8, 1e16
+
+
 class _Visitor(ast.NodeVisitor):
-    def __init__(self, relpath: str):
+    def __init__(self, relpath: str, lines: Optional[List[str]] = None):
         self.relpath = relpath
+        self.lines = lines or []
         self.findings: List[Tuple[int, str, str]] = []
         self.in_library = relpath.startswith("flexflow_tpu/")
+        self.in_rate_scope = (
+            (relpath.startswith("flexflow_tpu/ops/")
+             or relpath.startswith("flexflow_tpu/search/"))
+            and relpath not in _RL007_EXEMPT)
         self.is_resilience = relpath == "flexflow_tpu/resilience.py"
         self.in_diag_scope = (
             relpath.startswith("flexflow_tpu/strategy/")
@@ -120,6 +144,23 @@ class _Visitor(ast.NodeVisitor):
             self._check_rng(node, name)
             self._check_step_sync(node, name)
             self._check_raw_mesh(node, name)
+        self.generic_visit(node)
+
+    def visit_Constant(self, node: ast.Constant) -> None:
+        v = node.value
+        if self.in_rate_scope and isinstance(v, (int, float)) \
+                and not isinstance(v, bool) \
+                and _RL007_LO <= abs(v) < _RL007_HI:
+            line = (self.lines[node.lineno - 1]
+                    if 0 < node.lineno <= len(self.lines) else "")
+            if "RL007-ok" not in line:
+                self._add(node, "RL007",
+                          f"hardware-rate literal {v!r} outside "
+                          f"cost_model.DeviceSpec / the calibration "
+                          f"table — measured rates belong in the "
+                          f"CalibrationTable (flexflow-tpu calibrate), "
+                          f"spec-sheet rates in DeviceSpec; annotate "
+                          f"'RL007-ok: why' if this site is legitimate")
         self.generic_visit(node)
 
     def _check_raw_mesh(self, node: ast.Call, name: str) -> None:
@@ -242,7 +283,7 @@ def lint_file(path: str) -> List[str]:
         tree = ast.parse(src, filename=path)
     except SyntaxError as e:
         return [f"{rel}:{e.lineno or 0}: RL000 syntax error: {e.msg}"]
-    v = _Visitor(rel)
+    v = _Visitor(rel, src.splitlines())
     v.visit(tree)
     return [f"{rel}:{ln}: {code} {msg}"
             for ln, code, msg in sorted(v.findings)]

@@ -31,6 +31,17 @@ fi
 echo "== repo lint (scripts/repo_lint.py) =="
 python scripts/repo_lint.py "$@" || rc=1
 
+# calibration artifacts must parse against their schema and carry a
+# digest matching their content (flexflow-tpu calibrate --check) —
+# covers the committed seed table and any artifacts/calib_*.json
+calib_files="flexflow_tpu/search/calibration_seed.json"
+for f in artifacts/calib_*.json; do
+    [ -e "$f" ] && calib_files="$calib_files $f"
+done
+echo "== calibration artifact schema (calibrate --check) =="
+# shellcheck disable=SC2086
+python -m flexflow_tpu.cli calibrate --check $calib_files || rc=1
+
 if [ "$rc" -eq 0 ]; then
     echo "static checks: OK"
 else

@@ -22,6 +22,24 @@ def test_bench_graph_json_shape():
     assert r["speedup"] > 1.0
     assert r["num_ops"] == len(GRAPHS["dlrm"]())
     assert r["best_simulated_ms"] is None or r["best_simulated_ms"] > 0
+    # provenance fields (ISSUE 7 satellite): rows are comparable across
+    # machines and calibration states
+    assert r["estimator"] == "analytic"
+    assert r["calibration_digest"] is None
+    assert isinstance(r["device_kind"], str) and r["device_kind"]
+
+
+def test_bench_graph_calibrated_row():
+    """A calibrated bench row carries the estimator name + table digest
+    (the acceptance hook: search consumes the table, visibly)."""
+    from flexflow_tpu.search.calibration import (TableEstimator,
+                                                 default_table)
+    est = TableEstimator(default_table())
+    r = bench_graph("dlrm", num_devices=4, steps=12, budget=5,
+                    min_time_s=0.05, estimator=est)
+    assert r["estimator"] == "table"
+    assert r["calibration_digest"] == default_table().digest
+    assert r["proposals_per_sec_delta"] > 0
 
 
 def test_cli_search_bench_smoke(tmp_path):

@@ -454,6 +454,36 @@ def test_serving_metrics_snapshot():
     assert snap["dispatches"] == 2 and snap["requests"] == 100
 
 
+def test_serving_metrics_per_bucket_percentiles():
+    """Per-shape-bucket dispatch_ms percentiles (ISSUE 7 satellite): a
+    global mean hides which bucket executables are slow, and the
+    per-bucket medians are what the calibration harvest
+    (search.calibration.harvest_serve_dispatch) consumes."""
+    import json as _json
+    clk = FakeClock()
+    sm = ServingMetrics(window_s=100.0, clock=clk)
+    for ms in (2.0, 4.0, 6.0):
+        sm.record_dispatch(rows=4, bucket=4, n_reqs=1, queue_depth=0,
+                           dispatch_s=ms / 1e3)
+    sm.record_dispatch(rows=7, bucket=8, n_reqs=2, queue_depth=0,
+                       dispatch_s=0.010)
+    snap = sm.snapshot()
+    pb = snap["per_bucket"]
+    assert set(pb) == {"4", "8"}
+    assert pb["4"]["dispatches"] == 3 and pb["4"]["rows"] == 12
+    assert pb["4"]["dispatch_p50_ms"] == pytest.approx(4.0)
+    assert pb["4"]["dispatch_p99_ms"] == pytest.approx(6.0)
+    assert pb["8"]["dispatch_p50_ms"] == pytest.approx(10.0)
+    _json.loads(_json.dumps(snap))  # JSON-safe for the serve_stats event
+    # ...and the calibration harvest consumes exactly this shape
+    from flexflow_tpu.search.calibration import (CalibrationTable,
+                                                 harvest_serve_dispatch)
+    t = CalibrationTable()
+    assert harvest_serve_dispatch(t, "m", snap) == 2
+    assert t.dispatch["serve|m|bucket4"]["measured_ms"] == \
+        pytest.approx(4.0)
+
+
 def test_metrics_window_trims_old_samples():
     import json as _json
     clk = FakeClock()

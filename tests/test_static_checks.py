@@ -220,6 +220,34 @@ def test_static_checks_script_passes_on_repo():
      "def f(devs):\n"
      "    return Mesh(devs, ('x',))\n",
      None),
+    # RL007: hardware-rate literals (bytes/s, FLOP/s band) in op/search
+    # code are fossilized calibration numbers — they belong in
+    # cost_model.DeviceSpec or the CalibrationTable (ISSUE 7)
+    ("flexflow_tpu/ops/zz_bad_rate.py",
+     "HBM_BW = 819e9\n",
+     "RL007"),
+    ("flexflow_tpu/search/zz_bad_rate.py",
+     "def f():\n    return 2.5e10\n",
+     "RL007"),
+    # the annotated escape hatch for a legitimate site
+    ("flexflow_tpu/ops/zz_ok_rate_annot.py",
+     "PCIE_BW = 32e9  # RL007-ok: host-offload link, not a chip rate\n",
+     None),
+    # the device model and the calibration table are where rates LIVE
+    ("flexflow_tpu/search/cost_model.py",
+     "HBM_BW = 2765e9\n",
+     None),
+    ("flexflow_tpu/search/calibration.py",
+     "X = 459e12\n",
+     None),
+    # outside ops/ and search/ the rule does not engage; neither do
+    # sentinels/epsilons outside the rate band
+    ("flexflow_tpu/zz_ok_rate_elsewhere.py",
+     "B = 1e12\n",
+     None),
+    ("flexflow_tpu/search/zz_ok_small.py",
+     "INF_SENTINEL = 1e29\nEPS = 1e-6\nn = 4096\n",
+     None),
 ])
 def test_repo_lint_rules(tmp_path, rel, src, code):
     """repo_lint unit check on synthetic files, laid out under tmp_path
