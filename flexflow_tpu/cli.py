@@ -80,7 +80,9 @@ def main(argv=None) -> None:
               "-ll:cpu --nodes --profiling --seed --remat "
               "--steps-per-dispatch --pad-tail --calibration "
               "--cost-estimator "
-              "--serve-max-batch --serve-max-wait-ms --serve-buckets",
+              "--serve-max-batch --serve-max-wait-ms --serve-buckets "
+              "--serve-max-queue-rows --serve-admission "
+              "--serve-starvation-ms",
               file=sys.stderr)
         raise SystemExit(2)
     flags = [a for a in argv if a != script]

@@ -218,6 +218,23 @@ class FFConfig:
     # submitted, even if the batch is not full (latency floor under
     # light load; under heavy load batches fill before the deadline).
     serve_max_wait_ms: float = 2.0
+    # serve_max_queue_rows: bounded-queue admission control (docs/
+    # serving.md "Overload, SLOs & degradation").  0 = unbounded (the
+    # fair-weather default: nothing is ever rejected/shed, the
+    # un-overloaded path is bit-identical to an engine without
+    # admission control).  > 0 bounds the micro-batcher's pending rows;
+    # serve_admission picks what happens to a submit() that would
+    # overflow it: "block" (wait for room — backpressure), "reject"
+    # (fail fast with OverloadError, nothing queued) or "shed_oldest"
+    # (evict the oldest queued request of the lowest priority class not
+    # above the incoming one, failing it with SheddedError).
+    serve_max_queue_rows: int = 0
+    serve_admission: str = "block"
+    # serve_starvation_ms: anti-starvation aging bound for priority
+    # classes — a queued request older than this jumps the priority
+    # order, so sustained high-priority load delays low-priority work
+    # but can never starve it.  0 disables aging (strict priority).
+    serve_starvation_ms: float = 250.0
     # serve_buckets: explicit comma-separated batch buckets ("2,4,16,64");
     # empty = powers of two 2,4,...,serve_max_batch (the default omits
     # bucket 1 to keep results packing-invariant — single-row programs
@@ -322,6 +339,12 @@ class FFConfig:
                 cfg.serve_max_wait_ms = float(val())
             elif a == "--serve-buckets":
                 cfg.serve_buckets = val()
+            elif a == "--serve-max-queue-rows":
+                cfg.serve_max_queue_rows = int(val())
+            elif a == "--serve-admission":
+                cfg.serve_admission = val().lower()
+            elif a == "--serve-starvation-ms":
+                cfg.serve_starvation_ms = float(val())
             # unknown flags pass through (reference forwards Legion flags)
             i += 1
         return cfg

@@ -33,11 +33,32 @@ Grammar (specs joined by ``;``, qualifiers by ``,``)::
                           device count via ``devices=D``, default 2x /
                           half the current mesh)
 
+    serving kinds (consumed by ServingEngine's dispatcher before each
+    packed dispatch — :func:`serve_faults`; docs/serving.md "Overload,
+    SLOs & degradation"):
+
+    serve_slow_dispatch:N   the first N dispatches each stall ``ms``
+                            milliseconds (default 50) through the
+                            engine's injectable sleep — deterministic
+                            overload without a slow model
+    serve_fail_dispatch:N   inject N dispatch failures (RuntimeError on
+                            the normal dispatch-error path: the batch's
+                            futures fail, serving continues); ``every=K``
+                            spaces them every K-th dispatch (default 1 —
+                            the first N dispatches fail)
+    serve_queue_spike:N     at dispatch index N, push ``rows`` rows
+                            (default 4x max_batch) of synthetic load
+                            through the real admission path — the
+                            bounded-queue/shedding behavior under a
+                            burst is the thing being tested
+
     qualifiers: rank=R (fire only on rank R), attempt=A or attempt=*
                 (default attempt=0 — faults must not re-fire on the
                 restarted attempt or recovery could never be observed),
                 delay=SECONDS (slow_rank), exit=CODE (kill_at_step),
-                devices=D (grow_at_step/shrink_at_step target)
+                devices=D (grow_at_step/shrink_at_step target),
+                ms=MILLIS (serve_slow_dispatch), every=K
+                (serve_fail_dispatch), rows=R (serve_queue_spike)
 
 Examples::
 
@@ -70,7 +91,11 @@ KILL_EXIT_CODE = 17
 
 KINDS = ("kill_at_step", "hang_at_step", "corrupt_ckpt",
          "spawn_fail_attempt", "slow_rank", "grow_at_step",
-         "shrink_at_step")
+         "shrink_at_step", "serve_slow_dispatch", "serve_fail_dispatch",
+         "serve_queue_spike")
+
+SERVE_KINDS = ("serve_slow_dispatch", "serve_fail_dispatch",
+               "serve_queue_spike")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -118,14 +143,21 @@ def parse_faults(text: Optional[str]) -> List[FaultSpec]:
                 rank = int(val)
             elif key == "attempt":
                 attempt = None if val == "*" else int(val)
-            elif key in ("delay", "exit", "devices"):
+            elif key in ("delay", "exit", "devices", "ms", "every",
+                         "rows"):
                 # validate now, fail at parse not at fire — with the
                 # type actually used at fire time (exit=9.5 must not
                 # blow up inside the train loop)
-                (float if key == "delay" else int)(val)
-                if key == "devices" and int(val) < 1:
+                (float if key in ("delay", "ms") else int)(val)
+                if key in ("devices", "every", "rows") and int(val) < 1:
                     raise ValueError(
-                        f"devices qualifier must be >= 1, got {val!r} "
+                        f"{key} qualifier must be >= 1, got {val!r} "
+                        f"in {raw!r}")
+                if key == "ms" and float(val) < 0:
+                    # a negative stall would turn serve_slow_dispatch
+                    # into dispatch FAILURES at fire time (sleep raises)
+                    raise ValueError(
+                        f"ms qualifier must be >= 0, got {val!r} "
                         f"in {raw!r}")
                 extras[key] = val
             else:
@@ -299,6 +331,19 @@ def reshard_at_window(start: int, end: int):
                              end, f"devices={devices if devices else 'auto'}"))
             out.append((spec.kind, int(devices) if devices else None))
     return out
+
+
+def serve_faults() -> List[FaultSpec]:
+    """The FF_FAULT serving specs matching this rank/attempt, in plan
+    order (empty without a plan — the cached None-check keeps the
+    fault-free serving path cost-free).  The consumer is
+    ``ServingEngine``, which materializes per-engine firing state at
+    ``start()`` and consults it before each packed dispatch; this
+    module stays jax- and engine-free."""
+    p = plan()
+    if not p:
+        return []
+    return [s for s in p if s.kind in SERVE_KINDS and _matches(s)]
 
 
 def corrupt_file(path: str) -> None:

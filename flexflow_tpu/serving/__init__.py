@@ -1,12 +1,17 @@
 """flexflow_tpu.serving — the inference-serving subsystem
 (docs/serving.md): shape-bucketed AOT executables + a dynamic
-micro-batcher over a compiled FFModel, with rolling serving metrics and
-the ``flexflow-tpu serve-bench`` harness."""
+micro-batcher over a compiled FFModel, with admission control,
+per-request deadlines/priorities, engine health states, rolling
+serving metrics and the ``flexflow-tpu serve-bench`` harness."""
 
-from .batcher import (MicroBatcher, Request, bucket_for, derive_buckets,
-                      split_sizes)
-from .engine import ServingEngine
+from .batcher import (ADMISSION_POLICIES, MicroBatcher, Request, bucket_for,
+                      derive_buckets, split_sizes)
+from .engine import HEALTH_STATES, ServingEngine
+from .errors import (DeadlineExceeded, OverloadError, ServingError,
+                     SheddedError)
 from .metrics import ServingMetrics
 
 __all__ = ["ServingEngine", "MicroBatcher", "Request", "ServingMetrics",
+           "ServingError", "OverloadError", "SheddedError",
+           "DeadlineExceeded", "ADMISSION_POLICIES", "HEALTH_STATES",
            "bucket_for", "derive_buckets", "split_sizes"]

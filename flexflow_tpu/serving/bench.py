@@ -29,6 +29,13 @@ Run: ``python -m flexflow_tpu.cli serve-bench [--requests 512]
 [--burst 4] [--rate-frac 0.5] [--hidden 64] [--seed 0] [--out f.json]``
 — JSON on stdout either way.  Fully measurable on CPU (the dispatch
 overhead being amortized is exactly the part that needs no TPU).
+
+``--overload`` switches to the OVERLOAD SWEEP (docs/serving.md
+"Overload, SLOs & degradation"): measure capacity, then replay offered
+load at ``--mults`` x capacity under each ``--policies`` admission
+policy, reporting goodput (rows/s completed within the SLO) and
+shed/expired/reject rates per cell
+(``artifacts/serve_overload_r*.json``).
 """
 
 from __future__ import annotations
@@ -190,6 +197,207 @@ def _run_paced(model, reqs, rate: float, burst: int, seed: int) -> Dict:
     }
 
 
+# ----------------------------------------------------------------------
+# overload sweep: offered load x admission policy -> goodput
+# ----------------------------------------------------------------------
+# the four load regimes the sweep compares (docs/serving.md "Overload,
+# SLOs & degradation"): the unbounded-FIFO baseline (PR 5's fair-weather
+# engine) vs the three admission policies with deadlines on
+_OVERLOAD_POLICIES = {
+    # name: (admission, bounded?, deadlines?)
+    "fifo": ("block", False, False),
+    "shed_oldest": ("shed_oldest", True, True),
+    "reject": ("reject", True, True),
+    "block": ("block", True, True),
+}
+
+
+def _run_overload_cell(model, reqs, rate: float, policy: str,
+                       max_queue_rows: int, slo_ms: float, burst: int,
+                       seed: int, device_kind: str,
+                       calibration_digest) -> Dict:
+    """One sweep cell: open-loop Poisson(+burst) replay at ``rate``
+    req/s against a fresh engine configured for ``policy``, measuring
+    GOODPUT — rows completed within the SLO — plus every way a request
+    can fail (rejected / shed / expired / late), reconciled against the
+    submitted count.  The same ``slo_ms`` judges every policy: the
+    unbounded-FIFO baseline enforces no deadline, but its clients still
+    stopped caring after slo_ms."""
+    from ..profiling import quantiles
+    from .engine import ServingEngine
+    from .errors import OverloadError
+
+    admission, bounded, deadlines = _OVERLOAD_POLICIES[policy]
+    eng = ServingEngine(
+        model, stats_every=0,
+        max_queue_rows=max_queue_rows if bounded else 0,
+        admission=admission)
+    deadline_ms = slo_ms if deadlines else None
+    arrivals = make_arrivals(len(reqs), rate, seed, burst)
+    done: List[Dict] = []
+    t0 = time.perf_counter()
+    with eng:
+        for r, at in zip(reqs, arrivals):
+            lag = t0 + at - time.perf_counter()
+            if lag > 0:
+                time.sleep(lag)
+            ts = time.perf_counter()
+            try:
+                fut = eng.submit(r, deadline_ms=deadline_ms)
+            except OverloadError:
+                continue  # counted engine-side (snap["rejected"])
+            entry = {"rows": int(r.shape[0]), "t": ts, "t_done": None,
+                     "ok": False}
+
+            def cb(f, e=entry):
+                e["t_done"] = time.perf_counter()
+                e["ok"] = f.exception() is None and not f.cancelled()
+
+            fut.add_done_callback(cb)
+            done.append(entry)
+        # bounded graceful shutdown: flush what is queued, then fail
+        # stragglers — the drain verb under test, and what keeps the
+        # collapsing-baseline cell from running unboundedly long
+        eng.drain(timeout=max(1.0, 4 * slo_ms / 1e3))
+    t_end = time.perf_counter()
+    snap = eng.stats()
+    completed = [e for e in done if e["ok"] and e["t_done"] is not None]
+    lats = [(e["t_done"] - e["t"]) * 1e3 for e in completed]
+    good = [e for e, l in zip(completed, lats) if l <= slo_ms]
+    good_rows = sum(e["rows"] for e in good)
+    elapsed = max(1e-6, t_end - t0)
+    q = quantiles(lats)  # nearest-rank, unit-agnostic: these are ms
+
+    def _ms(v):
+        return None if v != v else round(v, 3)
+    # every submitted request must be accounted for exactly once:
+    # completed + rejected-at-submit + shed + expired + dispatch-errors
+    reconciled = (snap["requests"] + snap["rejected"] + snap["shed"]
+                  + snap["expired"] + snap["errors"]) == len(reqs)
+    return {
+        "policy": policy,
+        "admission": admission,
+        "deadline_ms": deadline_ms,
+        "slo_ms": slo_ms,
+        "max_queue_rows": max_queue_rows if bounded else 0,
+        "offered_rps": round(rate, 2),
+        "offered_requests": len(reqs),
+        "offered_rows": int(sum(r.shape[0] for r in reqs)),
+        "elapsed_s": round(elapsed, 4),
+        "completed": len(completed),
+        "good_requests": len(good),
+        "good_rows": int(good_rows),
+        "goodput_rows_per_s": round(good_rows / elapsed, 2),
+        "rejected": snap["rejected"],
+        "shed": snap["shed"],
+        "expired": snap["expired"],
+        "errors": snap["errors"],
+        "late": len(completed) - len(good),
+        "reconciled": bool(reconciled),
+        "peak_queue_rows": snap["peak_queue_rows"],
+        "admission_blocked_ms": snap["admission_blocked_ms"],
+        "p50_ms": _ms(q[0.5]), "p95_ms": _ms(q[0.95]),
+        "p99_ms": _ms(q[0.99]),
+        # PR 7's row-stamping convention: every row carries enough
+        # provenance to compare goodput trajectories across runs
+        "device_kind": device_kind,
+        "calibration_digest": calibration_digest,
+    }
+
+
+def run_overload_bench(requests: int = 512, rows_lo: int = 1,
+                       rows_hi: int = 8, max_batch: int = 32,
+                       max_wait_ms: float = 1.0, buckets: str = "",
+                       hidden: int = 256, seed: int = 0, burst: int = 4,
+                       cell_seconds: float = 2.0, slo_ms: float = 0.0,
+                       queue_rows: int = 0,
+                       mults=(0.5, 1.0, 2.0),
+                       policies=("fifo", "shed_oldest", "reject", "block"),
+                       calibration_digest=None) -> Dict:
+    """The overload sweep: measure engine capacity, then replay offered
+    load at ``mults`` x capacity under each admission policy, reporting
+    goodput (rows/s completed within the SLO) and shed/expired/reject
+    rates.  The acceptance shape (artifacts/serve_overload_r*.json): at
+    2x offered load, ``shed_oldest`` + deadlines holds queue depth <=
+    the bound and goodput >= 70% of its own 1x goodput, while the
+    unbounded-FIFO baseline's queue and latency diverge."""
+    import jax
+
+    from ..search.calibration import device_kind as _device_kind
+
+    model = _build_model(max_batch, hidden, seed, max_batch, max_wait_ms,
+                         buckets)
+    pool = make_requests(requests, rows_lo, rows_hi, seed)
+    model.predict(pool[0])  # warm predict's bucket like serve-bench
+    cap_row, _ = _run_engine_maxrate(model, pool)
+    capacity_rps = cap_row["qps_requests"]
+    mean_dispatch_ms = (cap_row["makespan_s"] / max(1, cap_row["dispatches"])
+                        * 1e3)
+    if slo_ms <= 0:
+        # auto SLO: several dispatches' worth of wall time + the
+        # coalescing wait — generous at 1x, hopeless for an unbounded
+        # backlog at 2x
+        slo_ms = max(25.0, 8 * mean_dispatch_ms + 2 * max_wait_ms)
+    if queue_rows <= 0:
+        queue_rows = 4 * max_batch
+    dk = _device_kind()
+    cells = []
+    for ci, (policy, mult) in enumerate(
+            (p, m) for p in policies for m in mults):
+        rate = max(1.0, capacity_rps * mult)
+        n = max(16, min(4096, int(rate * cell_seconds)))
+        reqs = [pool[i % len(pool)] for i in range(n)]
+        cell = _run_overload_cell(
+            model, reqs, rate, policy, queue_rows, slo_ms, burst,
+            seed + 13 * ci, dk, calibration_digest)
+        cell["offered_mult"] = mult
+        cells.append(cell)
+
+    def _cell(policy, mult):
+        # exact (policy, mult) match — a rate-ratio heuristic would
+        # silently drop the acceptance summary on hosts slow enough
+        # that the rate clamp distorts offered/capacity
+        for c in cells:
+            if c["policy"] == policy and c["offered_mult"] == mult:
+                return c
+        return None
+
+    summary = {}
+    shed1, shed2 = _cell("shed_oldest", 1.0), _cell("shed_oldest", 2.0)
+    fifo2 = _cell("fifo", 2.0)
+    if shed1 and shed2:
+        summary["goodput_1x_shed_rows_per_s"] = shed1["goodput_rows_per_s"]
+        summary["goodput_2x_shed_rows_per_s"] = shed2["goodput_rows_per_s"]
+        summary["goodput_2x_over_1x_shed"] = round(
+            shed2["goodput_rows_per_s"]
+            / max(1e-6, shed1["goodput_rows_per_s"]), 3)
+        summary["queue_bounded_at_2x"] = (
+            shed2["peak_queue_rows"] <= queue_rows)
+    if fifo2 and shed2:
+        summary["goodput_2x_fifo_rows_per_s"] = fifo2["goodput_rows_per_s"]
+        summary["fifo_2x_peak_queue_rows"] = fifo2["peak_queue_rows"]
+    return {
+        "bench": "serve-overload",
+        "backend": jax.default_backend(),
+        "device_kind": dk,
+        "estimator": "measured",
+        "config": {
+            "requests_pool": requests, "rows": f"{rows_lo}-{rows_hi}",
+            "max_batch": max_batch, "max_wait_ms": max_wait_ms,
+            "hidden": hidden, "seed": seed, "burst": burst,
+            "cell_seconds": cell_seconds, "slo_ms": round(slo_ms, 3),
+            "max_queue_rows": queue_rows,
+            "policies": list(policies), "mults": list(mults),
+        },
+        "capacity": {"qps_requests": capacity_rps,
+                     "qps_rows": cap_row["qps_rows"],
+                     "mean_dispatch_ms": round(mean_dispatch_ms, 3)},
+        "cells": cells,
+        "summary": summary,
+        "calibration_digest": calibration_digest,
+    }
+
+
 def run_serve_bench(requests: int = 512, rows_lo: int = 1, rows_hi: int = 8,
                     max_batch: int = 64, max_wait_ms: float = 2.0,
                     buckets: str = "", hidden: int = 64, seed: int = 0,
@@ -279,6 +487,24 @@ def main(argv=None) -> None:
                          "engine capacity")
     ap.add_argument("--hidden", type=int, default=64)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--overload", action="store_true",
+                    help="run the overload sweep instead of the "
+                         "three-phase bench: offered load x admission "
+                         "policy -> goodput (docs/serving.md "
+                         "'Overload, SLOs & degradation')")
+    ap.add_argument("--cell-seconds", type=float, default=2.0,
+                    help="overload: offered-load duration per cell")
+    ap.add_argument("--slo-ms", type=float, default=0.0,
+                    help="overload: goodput SLO / per-request deadline "
+                         "(0 = auto from measured dispatch time)")
+    ap.add_argument("--queue-rows", type=int, default=0,
+                    help="overload: serve_max_queue_rows for bounded "
+                         "policies (0 = auto, 4x max-batch)")
+    ap.add_argument("--mults", default="0.5,1,2",
+                    help="overload: offered-load multiples of measured "
+                         "capacity")
+    ap.add_argument("--policies", default="fifo,shed_oldest,reject,block",
+                    help="overload: admission policies to sweep")
     ap.add_argument("--calibration", default="",
                     help="CalibrationTable JSON whose digest the "
                          "payload records (comparability across "
@@ -308,11 +534,32 @@ def main(argv=None) -> None:
     # epoch event streams while measuring (restored after)
     from ..fflogger import silenced
     with silenced("ff", "serve"):
-        payload = run_serve_bench(
-            requests=args.requests, rows_lo=lo, rows_hi=hi,
-            max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
-            buckets=args.buckets, hidden=args.hidden, seed=args.seed,
-            burst=args.burst, rate_frac=args.rate_frac)
+        if args.overload:
+            try:
+                mults = tuple(float(v) for v in args.mults.split(",")
+                              if v.strip())
+                policies = tuple(p.strip() for p in
+                                 args.policies.split(",") if p.strip())
+            except ValueError:
+                ap.error(f"bad --mults {args.mults!r}")
+            unknown = [p for p in policies if p not in _OVERLOAD_POLICIES]
+            if unknown:
+                ap.error(f"unknown --policies {unknown} (have "
+                         f"{', '.join(_OVERLOAD_POLICIES)})")
+            payload = run_overload_bench(
+                requests=args.requests, rows_lo=lo, rows_hi=hi,
+                max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+                buckets=args.buckets, hidden=args.hidden,
+                seed=args.seed, burst=args.burst,
+                cell_seconds=args.cell_seconds, slo_ms=args.slo_ms,
+                queue_rows=args.queue_rows, mults=mults,
+                policies=policies, calibration_digest=digest)
+        else:
+            payload = run_serve_bench(
+                requests=args.requests, rows_lo=lo, rows_hi=hi,
+                max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+                buckets=args.buckets, hidden=args.hidden, seed=args.seed,
+                burst=args.burst, rate_frac=args.rate_frac)
     payload["calibration_digest"] = digest
     text = json.dumps(payload, indent=2)
     print(text)

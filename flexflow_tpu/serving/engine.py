@@ -21,6 +21,16 @@ the smallest covering bucket, runs the bucket executable with the
 model's device-pinned params (passed per call, never donated, never
 re-pinned), fetches once, and scatters per-request row slices back to
 the futures.
+
+Overload is a handled regime (docs/serving.md "Overload, SLOs &
+degradation"): the queue is bounded with block/reject/shed_oldest
+admission, requests carry deadlines (expired BEFORE packing — no dead
+dispatches) and priority classes, the engine walks a health state
+machine (``starting → serving → degraded → draining → stopped``) with
+a bounded :meth:`drain`, and the ``serve_slow_dispatch`` /
+``serve_fail_dispatch`` / ``serve_queue_spike`` FF_FAULT kinds inject
+the whole overload matrix deterministically (injectable clock + sleep,
+:mod:`flexflow_tpu.faults`).
 """
 
 from __future__ import annotations
@@ -28,14 +38,19 @@ from __future__ import annotations
 import threading
 import time
 from concurrent.futures import Future, InvalidStateError
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import faults
 from ..compile_cache import enable as _enable_compile_cache
-from .batcher import (MicroBatcher, Request, bucket_for, derive_buckets,
-                      split_sizes)
+from ..fflogger import get_logger
+from .batcher import (ADMISSION_POLICIES, MicroBatcher, Request, bucket_for,
+                      derive_buckets, split_sizes)
+from .errors import OverloadError, SheddedError
 from .metrics import ServingMetrics
+
+HEALTH_STATES = ("starting", "serving", "degraded", "draining", "stopped")
 
 
 def _resolve_future(fut: Future, out) -> bool:
@@ -65,15 +80,20 @@ class _Join:
     submit: chunk outputs land by index (the single dispatcher thread
     completes them in FIFO order, but indexing is order-free anyway)
     and the logical future resolves once — with the concatenated rows —
-    when the last chunk arrives."""
+    when the last chunk arrives.  On the error/expiry path the FIRST
+    failing chunk resolves the future; the surviving queued siblings
+    turn stale (``future.done()``) and the batcher drops them before
+    packing, which is what makes split-request expiry atomic: the
+    logical request fails once and no orphan chunk burns a dispatch."""
 
     def __init__(self, future: Future, nparts: int, t_submit: float,
-                 metrics: ServingMetrics):
+                 metrics: ServingMetrics, deadlined: bool = False):
         self.future = future
         self.parts: list = [None] * nparts
         self.missing = nparts
         self.t_submit = t_submit
         self.metrics = metrics
+        self.deadlined = deadlined
         self.lock = threading.Lock()
 
     def part(self, i: int) -> Callable:
@@ -90,14 +110,18 @@ class _Join:
             if self.future.done():
                 return False
             if isinstance(out, BaseException):
-                return _resolve_future(self.future, out)
+                if _resolve_future(self.future, out):
+                    self.metrics.record_failure(out)
+                    return True
+                return False
             self.parts[i] = out
             self.missing -= 1
             if self.missing:
                 return False
         if _resolve_future(self.future,
                            np.concatenate(self.parts, axis=0)):
-            self.metrics.record_request(now - self.t_submit)
+            self.metrics.record_request(now - self.t_submit,
+                                        deadlined=self.deadlined)
             return True
         return False
 
@@ -113,15 +137,23 @@ class ServingEngine:
             y = fut.result()                   # (n, num_classes)
 
     Knobs resolve from ``model.config`` (CLI ``--serve-max-batch``,
-    ``--serve-max-wait-ms``, ``--serve-buckets``) unless overridden by
-    constructor arguments; ``clock`` is injectable for deterministic
-    tests."""
+    ``--serve-max-wait-ms``, ``--serve-buckets``, ``--serve-max-queue-
+    rows``, ``--serve-admission``, ``--serve-starvation-ms``) unless
+    overridden by constructor arguments; ``clock`` and ``sleep`` are
+    injectable for deterministic tests (``sleep`` is only ever used by
+    the ``serve_slow_dispatch`` fault)."""
 
     def __init__(self, model, max_batch: Optional[int] = None,
                  max_wait_ms: Optional[float] = None,
                  buckets: Optional[str] = None, stats_every: int = 64,
                  metrics_window_s: float = 30.0,
-                 clock: Callable[[], float] = time.monotonic):
+                 max_queue_rows: Optional[int] = None,
+                 admission: Optional[str] = None,
+                 starvation_ms: Optional[float] = None,
+                 degraded_after_errors: int = 2,
+                 degraded_drop_frac: float = 0.5,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
         assert model._compiled, "compile() + init_layers() the model first"
         # persistent compile cache: bucket warmup below is exactly the
         # compile-once-at-startup cost the cache makes warm across
@@ -135,11 +167,26 @@ class ServingEngine:
             cfg.serve_max_wait_ms if max_wait_ms is None else max_wait_ms)
         self.buckets: Tuple[int, ...] = derive_buckets(
             self.max_batch, cfg.serve_buckets if buckets is None else buckets)
+        self.max_queue_rows = int(
+            cfg.serve_max_queue_rows if max_queue_rows is None
+            else max_queue_rows)
+        self.admission = (cfg.serve_admission if admission is None
+                          else admission)
+        if self.admission not in ADMISSION_POLICIES:
+            raise ValueError(
+                f"unknown serve_admission {self.admission!r} (want one "
+                f"of {', '.join(ADMISSION_POLICIES)})")
         self.clock = clock
+        self._sleep = sleep
         self.stats_every = int(stats_every)
-        self.metrics = ServingMetrics(window_s=metrics_window_s, clock=clock)
-        self._batcher = MicroBatcher(self.max_batch, self.max_wait_ms,
-                                     clock=clock)
+        self._batcher = MicroBatcher(
+            self.max_batch, self.max_wait_ms, clock=clock,
+            max_queue_rows=self.max_queue_rows, admission=self.admission,
+            starvation_ms=float(cfg.serve_starvation_ms
+                                if starvation_ms is None else starvation_ms))
+        self.metrics = ServingMetrics(
+            window_s=metrics_window_s, clock=clock,
+            queue_depth_fn=lambda: self._batcher.queue_depth)
         self._n_inputs = len(model.input_tensors)
         self._in_dtypes = [t.dtype for t in model.input_tensors]
         self._in_shapes = [tuple(t.shape[1:]) for t in model.input_tensors]
@@ -152,7 +199,65 @@ class ServingEngine:
         self._thread: Optional[threading.Thread] = None
         self._n_dispatch = 0
         self._stopped = False
+        self._draining = False
+        self._consec_errors = 0
+        self._degraded_after_errors = int(degraded_after_errors)
+        self._degraded_drop_frac = float(degraded_drop_frac)
+        self._last_health = "starting"
+        self._health_lock = threading.Lock()
+        self._finalized = False  # final serve_stats emitted exactly once
+        self._shutdown_done = threading.Event()
+        self._serve_faults: List[Dict] = []
         self._lifecycle = threading.Lock()
+
+    # ---- health state machine ------------------------------------------
+    @property
+    def health(self) -> str:
+        """Engine lifecycle/health state: ``starting`` (constructed,
+        dispatcher not running), ``serving``, ``degraded`` (consecutive
+        dispatch errors or windowed shed+reject rate over threshold —
+        still serving what it can), ``draining`` (drain() in progress:
+        no admissions, queue flushing) or ``stopped``.  Computed from
+        live counters, so a recovery — successful dispatch, drop rate
+        decaying out of the window — flips it back without an edge
+        event having to fire first."""
+        if self._stopped:
+            return "stopped"
+        if self._draining:
+            return "draining"
+        if self._thread is None:
+            return "starting"
+        if self._consec_errors >= self._degraded_after_errors:
+            return "degraded"
+        rate, submitted = self.metrics.drop_stats()
+        if submitted >= 4 and rate >= self._degraded_drop_frac:
+            return "degraded"
+        return "serving"
+
+    def _health_tick(self) -> None:
+        """Emit a structured ``serve_health`` event on state edges —
+        the pull-side `health` property is always live, but a
+        transition must also be visible in the event stream.  The
+        compare-and-set on ``_last_health`` is locked: ticks fire from
+        producer threads (reject paths) AND the dispatcher, and an
+        unsynchronized read-modify-write would duplicate or swallow
+        edges in the event stream."""
+        with self._health_lock:
+            # state is computed INSIDE the lock and the event emitted
+            # before releasing it: a tick that computed its state
+            # earlier but committed later would write a reversed edge
+            # into both _last_health and the event stream
+            state = self.health
+            prev = self._last_health
+            if state == prev:
+                return
+            self._last_health = state
+            rate, submitted = self.metrics.drop_stats()
+            get_logger("serve").event(
+                "serve_health", prev=prev, state=state,
+                consec_errors=self._consec_errors,
+                drop_rate=round(rate, 4), window_submitted=submitted,
+                queue_depth=self._batcher.queue_depth)
 
     # ---- lifecycle -----------------------------------------------------
     def start(self) -> "ServingEngine":
@@ -167,34 +272,46 @@ class ServingEngine:
                     "(the AOT bucket executables are cached on the "
                     "model, so a fresh engine starts warm)")
             if self._thread is None:
+                self._serve_faults = _load_serve_faults()
                 self._thread = threading.Thread(
                     target=self._dispatch_loop, name="ff-serve-dispatch",
                     daemon=True)
                 self._thread.start()
+        self._health_tick()
         return self
 
     def stop(self) -> None:
-        """Drain pending requests, stop the dispatcher, emit final
-        stats.  Idempotent and safe under concurrent callers — the
-        lifecycle lock serializes them, every stop() returns only once
-        the drain finished, and only the first emits the final
-        snapshot (the dispatcher thread never takes this lock, so
-        holding it across the join cannot deadlock).  The engine is
-        single-use — see start()."""
+        """Drain pending requests fully (unbounded), stop the
+        dispatcher, emit final stats.  Idempotent and safe under
+        concurrent callers — the lifecycle lock serializes them, every
+        stop() returns only once the drain finished, and only the
+        first emits the final snapshot (the dispatcher thread never
+        takes this lock, so holding it across the join cannot
+        deadlock).  The engine is single-use — see start().  For a
+        BOUNDED drain that fails stragglers instead of waiting them
+        out, see :meth:`drain`."""
         with self._lifecycle:
             self._stopped = True
             self._batcher.close()
             if self._thread is not None:
                 self._thread.join()
                 self._thread = None
-                self.metrics.emit(extra={"final": True,
-                                         "max_batch": self.max_batch})
+                if not self._finalized:
+                    # exactly one final snapshot, even when stop() and
+                    # drain() race — whichever joins first emits
+                    self._finalized = True
+                    self.metrics.emit(extra={"final": True,
+                                             "max_batch": self.max_batch,
+                                             "health": "stopped"})
             else:
                 # never started: there is no dispatcher to drain the
                 # queue, so fail any futures queued before stop() —
-                # leaving them pending would block result() forever
+                # leaving them pending would block result() forever.
+                # SheddedError, like drain()'s stragglers: a shutdown
+                # eviction is load management, and the typed contract
+                # (`except ServingError`) must cover it
                 now = self.clock()
-                err = RuntimeError(
+                err = SheddedError(
                     "engine stopped before it was started")
                 while True:
                     reqs = self._batcher.poll()
@@ -202,6 +319,87 @@ class ServingEngine:
                         break
                     for r in reqs:
                         r.on_done(err, now)
+        self._health_tick()
+        self._shutdown_done.set()
+
+    def drain(self, timeout: Optional[float] = None) -> Dict:
+        """Graceful shutdown verb: stop admitting (subsequent
+        ``submit`` raises), flush what is queued, and after ``timeout``
+        seconds fail the stragglers with :class:`SheddedError` instead
+        of waiting for them (None = wait forever, like stop()).
+        Returns the final stats snapshot.  Idempotent; the engine is
+        stopped afterwards (single-use, like stop())."""
+        with self._lifecycle:
+            # _draining gates concurrent drain()/drain(): only the
+            # first caller runs the shutdown (stop() racing in is
+            # handled by the _finalized emit-once guard)
+            already = self._stopped or self._draining
+            thread = self._thread
+            if not already:
+                self._draining = True
+                self._batcher.close()
+        if already:
+            # a concurrent first drain()/stop() is still shutting
+            # down: wait it out, so every drain() returns only once
+            # the engine really is stopped (the documented
+            # postcondition — callers tear down shared state next)
+            self._shutdown_done.wait()
+            return self.stats()
+        self._health_tick()
+        get_logger("serve").event(
+            "serve_drain", timeout_s=timeout,
+            queue_depth=self._batcher.queue_depth,
+            pending_rows=self._batcher.pending_rows)
+        shed = 0
+        if thread is not None:
+            thread.join(timeout)
+            if thread.is_alive():
+                # dispatcher still busy past the budget: pull the
+                # remaining queue out from under it and fail those
+                # futures fast — the in-flight batch still completes
+                stragglers = self._batcher.fail_pending()
+                now = self.clock()
+                for r in stragglers:
+                    if r.on_done(SheddedError(
+                            f"engine drained with work still queued "
+                            f"(drain timeout {timeout}s)"), now):
+                        shed += 1
+                # bounded SECOND join too: a dispatcher wedged inside a
+                # device call (the unhealthy case drain exists for)
+                # must not hang the shutdown path — give the in-flight
+                # dispatch one more budget, then abandon the daemon
+                # thread and finish shutting down
+                thread.join(timeout)
+                if thread.is_alive():
+                    get_logger("serve").event(
+                        "serve_drain_abandoned",
+                        timeout_s=timeout,
+                        note="dispatcher wedged in an in-flight "
+                             "dispatch; daemon thread abandoned")
+        else:
+            now = self.clock()
+            for r in self._batcher.fail_pending():
+                if r.on_done(SheddedError(
+                        "engine drained before it was started"), now):
+                    shed += 1
+        with self._lifecycle:
+            # _stopped BEFORE clearing _draining: the lock-free health
+            # property must never observe the (not stopped, not
+            # draining) gap and report a shut-down engine as 'serving'
+            self._stopped = True
+            self._draining = False
+            self._thread = None
+            first = not self._finalized
+            self._finalized = True
+        self._health_tick()
+        snap = self.stats()
+        if first:
+            self.metrics.emit(extra={"final": True,
+                                     "max_batch": self.max_batch,
+                                     "health": "stopped",
+                                     "drain_shed": shed})
+        self._shutdown_done.set()
+        return snap
 
     def __enter__(self) -> "ServingEngine":
         return self.start()
@@ -210,12 +408,24 @@ class ServingEngine:
         self.stop()
 
     # ---- producer side -------------------------------------------------
-    def submit(self, *xs) -> Future:
+    def submit(self, *xs, deadline_ms: Optional[float] = None,
+               priority: int = 0) -> Future:
         """Queue one inference request of ``n`` rows (each positional
         arg is one model input, leading dim ``n``) and return a Future
         resolving to the ``(n, ...)`` output rows.  Thread-safe.
         Requests larger than ``max_batch`` are split into chunks and
-        transparently reassembled."""
+        transparently reassembled.
+
+        ``deadline_ms`` (from submit time): if the request is still
+        queued when it passes, the batcher expires it before packing
+        and the future fails with :class:`DeadlineExceeded` — no device
+        dispatch is burned.  ``priority`` (int, higher = served first)
+        picks the admission/coalescing class; FIFO order holds within a
+        class and the starvation bound keeps lower classes moving.
+        Under a full bounded queue, ``reject``/unsheddable admission
+        raises :class:`OverloadError` synchronously (fail fast — the
+        request never queued) and ``shed_oldest`` may fail OTHER queued
+        futures with :class:`SheddedError`."""
         if len(xs) != self._n_inputs:
             raise ValueError(f"model has {self._n_inputs} input(s), got "
                              f"{len(xs)}")
@@ -223,8 +433,18 @@ class ServingEngine:
         # the queue up to max_wait_ms (longer under load) — a caller
         # reusing its buffer must not mutate an in-flight request, so
         # the engine owns its copy from the moment submit() returns
-        arrs = tuple(np.array(a, dtype=d, copy=True)
-                     for a, d in zip(xs, self._in_dtypes))
+        arrs = []
+        for i, (a, d) in enumerate(zip(xs, self._in_dtypes)):
+            try:
+                arrs.append(np.array(a, dtype=d, copy=True))
+            except (ValueError, TypeError) as e:
+                # a ragged/uncoercible payload must name the offending
+                # input, not surface numpy's opaque internals
+                raise ValueError(
+                    f"input {i}: cannot coerce to a "
+                    f"{np.dtype(d).name} array of rows shaped "
+                    f"{self._in_shapes[i]}: {e}") from e
+        arrs = tuple(arrs)
         if any(a.ndim == 0 for a in arrs):
             raise ValueError("request inputs must have a leading row "
                              "dimension (shape (n, ...))")
@@ -234,49 +454,155 @@ class ServingEngine:
         if any(a.shape[0] != n for a in arrs):
             raise ValueError(f"inputs disagree on row count: "
                              f"{[a.shape[0] for a in arrs]}")
-        for a, want in zip(arrs, self._in_shapes):
+        for i, (a, want) in enumerate(zip(arrs, self._in_shapes)):
             # reject the malformed request HERE: packed into a batch,
             # its bad trailing shape would fail the whole dispatch and
             # poison every innocent request coalesced with it
             if tuple(a.shape[1:]) != want:
                 raise ValueError(
-                    f"request rows shaped {tuple(a.shape[1:])} do not "
-                    f"match the model input {want}")
+                    f"input {i}: request rows shaped {tuple(a.shape[1:])} "
+                    f"do not match the model input {want}")
         fut: Future = Future()
         t0 = self.clock()
+        deadline = None if deadline_ms is None else t0 + deadline_ms / 1e3
+        self.metrics.record_submitted()
+        metrics = self.metrics
         sizes = split_sizes(n, self.max_batch)
         if len(sizes) == 1:
-            metrics = self.metrics
+            deadlined = deadline is not None
 
             def on_done(out, now: float) -> bool:
                 if isinstance(out, BaseException):
-                    return _resolve_future(fut, out)
+                    if _resolve_future(fut, out):
+                        metrics.record_failure(out)
+                        return True
+                    return False
                 if _resolve_future(fut, out):
-                    metrics.record_request(now - t0)
+                    metrics.record_request(now - t0, deadlined=deadlined)
                     return True
                 return False
 
-            self._batcher.submit(Request(arrs, n, on_done, t0))
+            reqs = [Request(arrs, n, on_done, t0, deadline=deadline,
+                            priority=priority)]
         else:
-            join = _Join(fut, len(sizes), t0, self.metrics)
-            chunks = []
+            join = _Join(fut, len(sizes), t0, self.metrics,
+                         deadlined=deadline is not None)
+            reqs = []
             off = 0
             for i, sz in enumerate(sizes):
                 chunk = tuple(a[off:off + sz] for a in arrs)
-                chunks.append(Request(chunk, sz, join.part(i), t0))
+                # stale=future.done: once any sibling fails/expires the
+                # join, the rest are dead weight and the batcher drops
+                # them before packing (atomic expiry/cancel)
+                reqs.append(Request(chunk, sz, join.part(i), t0,
+                                    deadline=deadline, priority=priority,
+                                    stale=fut.done))
                 off += sz
+        try:
             # atomic: all chunks or none (a concurrent stop() must not
             # strand already-queued chunks of a request whose submit
             # raised)
-            self._batcher.submit_all(chunks)
+            blocked_s = self._batcher.submit_all(reqs)
+        except OverloadError:
+            self.metrics.record_rejected()
+            self._health_tick()
+            raise
+        except RuntimeError as e:
+            # the batcher closes exactly when the engine is draining or
+            # stopped: surface the typed admission error the errors.py
+            # contract promises (`except ServingError` must catch a
+            # drain-time refusal, not crash on a bare RuntimeError) —
+            # and COUNT it, or record_submitted() above would leave a
+            # request with no recorded outcome and break the
+            # submitted == requests+rejected+shed+expired+errors
+            # reconciliation serve-bench pins
+            self.metrics.record_rejected()
+            raise OverloadError(
+                f"engine is not admitting new work ({e})") from e
+        if blocked_s > 0:
+            self.metrics.record_blocked(blocked_s)
         return fut
 
     def stats(self) -> Dict:
-        """Rolling metrics snapshot plus engine shape (pull-side
-        counterpart of the periodic ``serve_stats`` events)."""
+        """Rolling metrics snapshot plus engine shape and health
+        (pull-side counterpart of the periodic ``serve_stats``
+        events).  ``queue_depth`` is LIVE (the batcher's current
+        count, not the last dispatch's view) and
+        ``last_dispatch_age_s``/``health`` make a wedged dispatcher
+        visible instead of frozen-healthy."""
         return {**self.metrics.snapshot(), "max_batch": self.max_batch,
                 "max_wait_ms": self.max_wait_ms,
-                "buckets": list(self.buckets)}
+                "buckets": list(self.buckets),
+                "health": self.health,
+                "admission": self.admission,
+                "max_queue_rows": self.max_queue_rows,
+                "peak_queue_rows": self._batcher.peak_rows}
+
+    # ---- fault injection (FF_FAULT serve_* kinds) ----------------------
+    def _fire_serve_faults(self) -> None:
+        """Consult the FF_FAULT serve kinds before dispatch
+        ``self._n_dispatch`` (flexflow_tpu.faults grammar).  May sleep
+        (serve_slow_dispatch — through the injectable ``sleep``), raise
+        (serve_fail_dispatch — the normal dispatch-error path fails the
+        batch's futures and serving continues) or inject a synthetic
+        queue spike (serve_queue_spike — real rows through the real
+        admission path, never blocking the dispatcher).  No-op without
+        an active plan."""
+        if not self._serve_faults:
+            return
+        idx = self._n_dispatch
+        for st in self._serve_faults:
+            kind, n = st["kind"], st["n"]
+            if kind == "serve_slow_dispatch":
+                if st["fired"] < n:
+                    st["fired"] += 1
+                    self._sleep(st["ms"] / 1e3)
+            elif kind == "serve_queue_spike":
+                if idx == n and not st["fired"]:
+                    st["fired"] += 1
+                    # default spike: 4x the packed-batch size — enough
+                    # to overflow a typical bounded queue
+                    self._inject_spike(st["rows"] or 4 * self.max_batch)
+            elif kind == "serve_fail_dispatch":
+                st["seen"] += 1
+                if st["fired"] < n and st["seen"] % st["every"] == 0:
+                    st["fired"] += 1
+                    raise RuntimeError(
+                        f"FF_FAULT: injected serve dispatch failure "
+                        f"{st['fired']}/{n} (dispatch {idx})")
+
+    def _inject_spike(self, rows: int) -> None:
+        """Queue-spike fault: push ``rows`` rows of synthetic load
+        through the REAL admission path (so shed/reject behavior under
+        the spike is the behavior being tested), except that `block`
+        downgrades to `reject` — the dispatcher thread must never park
+        itself waiting for the room only it can free."""
+        from .errors import ServingError
+        zeros = tuple(np.zeros((min(rows, self.max_batch),) + s, d)
+                      for s, d in zip(self._in_shapes, self._in_dtypes))
+        metrics = self.metrics
+        policy = "reject" if self.admission == "block" else self.admission
+
+        def on_done(out, now: float) -> bool:
+            if isinstance(out, BaseException):
+                metrics.record_failure(out)
+            return True
+
+        left = rows
+        while left > 0:
+            sz = min(left, self.max_batch)
+            xs = tuple(z[:sz] for z in zeros)
+            self.metrics.record_submitted()
+            try:
+                self._batcher.submit_all(
+                    [Request(xs, sz, on_done, self.clock(),
+                             priority=-(1 << 30))],
+                    admission=policy)
+            except ServingError:
+                self.metrics.record_rejected()
+            except RuntimeError:
+                return  # batcher closed mid-spike: drain wins
+            left -= sz
 
     # ---- dispatcher thread ---------------------------------------------
     def _dispatch_loop(self) -> None:
@@ -285,25 +611,27 @@ class ServingEngine:
             if reqs is None:
                 return  # closed and drained
             try:
+                self._fire_serve_faults()
                 self._dispatch_batch(reqs)
             except BaseException as e:  # noqa: BLE001 — one poisoned
                 # batch must fail ITS futures, not kill the dispatcher:
                 # the engine keeps serving subsequent batches.  on_done
-                # reports whether it completed the LOGICAL request, so
-                # split chunks count their request once (the same
-                # population serve_stats' ``errors`` counter reports).
+                # reports whether it completed the LOGICAL request (and
+                # records the failure class), so split chunks count
+                # their request once — the same population serve_stats'
+                # ``errors`` counter reports.
+                self._consec_errors += 1
                 now = self.clock()
                 failed = sum(1 for r in reqs if r.on_done(e, now))
-                self.metrics.record_errors(failed)
                 # one structured line per failed dispatch: a failure
                 # storm must be visible in the event stream, not only
                 # as a counter clients discover via exceptions
-                from ..fflogger import get_logger
                 get_logger("serve").event(
                     "serve_dispatch_error",
                     error=f"{type(e).__name__}: {e}"[:300],
                     failed_requests=failed,
                     errors_total=self.metrics.total_errors)
+                self._health_tick()
 
     def _dispatch_batch(self, reqs) -> None:
         import jax
@@ -338,6 +666,13 @@ class ServingEngine:
             # inside the scatter loop)
             host = np.asarray(jax.device_get(out))
         now = self.clock()
+        # the dispatch succeeded the moment the fetch returned: reset
+        # the error streak and emit the recovery edge BEFORE scattering
+        # — a client whose future just resolved must never observe a
+        # stale `degraded`, and a concurrent stop() right after
+        # result() must not swallow the degraded->serving transition
+        self._consec_errors = 0
+        self._health_tick()
         self.metrics.record_dispatch(rows, bucket, len(reqs), depth,
                                      now - t0)
         off = 0
@@ -348,4 +683,23 @@ class ServingEngine:
             r.on_done(host[off:off + r.n].copy(), now)
             off += r.n
         if self.stats_every and self._n_dispatch % self.stats_every == 0:
-            self.metrics.emit(extra={"max_batch": self.max_batch})
+            self.metrics.emit(extra={"max_batch": self.max_batch,
+                                     "health": self.health})
+
+
+def _load_serve_faults() -> List[Dict]:
+    """Materialize the FF_FAULT serve_* specs into per-engine firing
+    state (start() calls this once per engine; the cached plan() check
+    keeps the no-FF_FAULT path a None-test)."""
+    out: List[Dict] = []
+    for spec in faults.serve_faults():
+        out.append({
+            "kind": spec.kind,
+            "n": int(spec.arg),
+            "ms": float(spec.extras.get("ms", "50")),
+            "every": max(1, int(spec.extras.get("every", "1"))),
+            "rows": int(spec.extras.get("rows", "0")),
+            "seen": 0,
+            "fired": 0,
+        })
+    return out

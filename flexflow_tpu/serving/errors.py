@@ -1,0 +1,41 @@
+"""Typed serving failures (docs/serving.md "Overload, SLOs &
+degradation").
+
+Overload is a handled regime, not an accident: when the engine cannot
+serve a request it fails FAST with one of these types so a client can
+distinguish "retry elsewhere / back off" (admission) from "the answer
+arrived too late to matter" (deadline) and react per class.  All three
+derive from :class:`ServingError` so ``except ServingError`` catches
+exactly the engine's load-management failures and nothing else — a
+dispatch bug (device error, shape mismatch) still surfaces as whatever
+it was.
+
+Deliberately dependency-free: the batcher raises/injects these without
+importing the engine or metrics.
+"""
+
+from __future__ import annotations
+
+
+class ServingError(RuntimeError):
+    """Base class for load-management failures of the serving engine."""
+
+
+class OverloadError(ServingError):
+    """Request refused at admission: the bounded queue was full under
+    the ``reject`` policy (or could not be made to fit under
+    ``shed_oldest``), or the engine is draining.  Raised synchronously
+    from ``submit()`` — the request never entered the queue."""
+
+
+class SheddedError(ServingError):
+    """A QUEUED request was evicted to make room for newer work
+    (``shed_oldest`` admission) or failed by ``drain(timeout)`` as a
+    straggler.  Delivered through the request's future."""
+
+
+class DeadlineExceeded(ServingError):
+    """The request's ``deadline_ms`` passed while it was still queued;
+    the batcher expired it BEFORE packing, so no device dispatch was
+    burned on an answer nobody is waiting for.  Delivered through the
+    request's future."""

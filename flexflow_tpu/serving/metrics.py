@@ -8,6 +8,15 @@ Quantiles come from :func:`flexflow_tpu.profiling.quantiles`
 (nearest-rank — every reported p50/p95/p99 is a latency that actually
 happened).  All state is windowed/bounded: a week-long serving process
 must not grow its metrics memory with traffic.
+
+Overload accounting (docs/serving.md "Overload, SLOs & degradation"):
+``rejected`` / ``shed`` / ``expired`` lifetime counters classify every
+load-management failure by its typed exception
+(:mod:`flexflow_tpu.serving.errors`), ``admission_blocked_ms``
+accumulates producer time spent blocked for admission, and
+``deadline_p99_ms`` tracks the latency tail of the requests that
+carried a deadline — the SLO-attainment gauge.  The windowed drop rate
+(``drop_stats``) feeds the engine's ``degraded`` health transition.
 """
 
 from __future__ import annotations
@@ -15,10 +24,11 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Callable, Dict
+from typing import Callable, Dict, Optional, Tuple
 
 from ..fflogger import get_logger
 from ..profiling import quantiles
+from .errors import DeadlineExceeded, SheddedError
 
 
 class ServingMetrics:
@@ -28,30 +38,67 @@ class ServingMetrics:
     thread, one per packed batch; request-side records
     (`record_request`) fire when a logical request's future resolves.
     `snapshot()` reduces the rolling window to the flat dict that both
-    the ``serve_stats`` JSON event and serve-bench report."""
+    the ``serve_stats`` JSON event and serve-bench report.
+
+    ``queue_depth_fn`` (settable after construction) makes the reported
+    queue depth LIVE: without it, depth freezes at the last dispatch —
+    a wedged dispatcher behind a growing queue would look healthy.  The
+    engine points it at ``batcher.queue_depth``; ``last_dispatch_age_s``
+    is the stall gauge's other half."""
 
     def __init__(self, window_s: float = 30.0, max_latency_samples: int = 4096,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 queue_depth_fn: Optional[Callable[[], int]] = None):
         self.window_s = float(window_s)
         self.clock = clock
+        self.queue_depth_fn = queue_depth_fn
         self._lock = threading.Lock()
         # (t, rows, bucket, n_reqs, dispatch_s) per packed batch
         self._dispatches: deque = deque()
         # (t, latency_s) per completed logical request
         self._latencies: deque = deque(maxlen=max_latency_samples)
+        # (t, latency_s) for the subset that carried a deadline — the
+        # SLO-attainment population deadline_p99_ms reports on
+        self._deadline_lats: deque = deque(maxlen=max_latency_samples)
+        # (t, n) windowed admission/drop event streams for the health
+        # state machine's shed-rate threshold, with RUNNING sums so
+        # drop_stats() is O(1) on the hot dispatcher path; trimmed on
+        # every append (not only on reads) and hard-capped so a wedged
+        # dispatcher under a submit storm cannot grow metrics memory
+        self._submit_ts: deque = deque()
+        self._drop_ts: deque = deque()
+        self._submit_n = 0
+        self._drop_n = 0
         self._queue_depth = 0
+        self._last_dispatch_t: Optional[float] = None
         self.total_dispatches = 0
         self.total_requests = 0
         self.total_rows = 0
         self.total_errors = 0
+        self.total_rejected = 0
+        self.total_shed = 0
+        self.total_expired = 0
+        self.blocked_ms_total = 0.0
+
+    # hard cap on windowed admission/drop EVENTS (not requests — each
+    # entry may carry n>1): bounds memory even when the window itself
+    # would hold more
+    _MAX_WINDOW_EVENTS = 65536
 
     # ---- recording -----------------------------------------------------
     def _trim(self, now: float) -> None:
         horizon = now - self.window_s
-        while self._dispatches and self._dispatches[0][0] < horizon:
-            self._dispatches.popleft()
-        while self._latencies and self._latencies[0][0] < horizon:
-            self._latencies.popleft()
+        for dq in (self._dispatches, self._latencies, self._deadline_lats):
+            while dq and dq[0][0] < horizon:
+                dq.popleft()
+        while self._submit_ts and (self._submit_ts[0][0] < horizon
+                                   or len(self._submit_ts)
+                                   > self._MAX_WINDOW_EVENTS):
+            self._submit_n -= self._submit_ts.popleft()[1]
+        while self._drop_ts and (self._drop_ts[0][0] < horizon
+                                 or len(self._drop_ts)
+                                 > self._MAX_WINDOW_EVENTS):
+            self._drop_n -= self._drop_ts.popleft()[1]
 
     def record_dispatch(self, rows: int, bucket: int, n_reqs: int,
                         queue_depth: int, dispatch_s: float) -> None:
@@ -59,24 +106,76 @@ class ServingMetrics:
         with self._lock:
             self._dispatches.append((now, rows, bucket, n_reqs, dispatch_s))
             self._queue_depth = queue_depth
+            self._last_dispatch_t = now
             self.total_dispatches += 1
             self.total_rows += rows
             self._trim(now)
 
-    def record_request(self, latency_s: float) -> None:
+    def record_request(self, latency_s: float,
+                       deadlined: bool = False) -> None:
         now = self.clock()
         with self._lock:
             self._latencies.append((now, latency_s))
+            if deadlined:
+                self._deadline_lats.append((now, latency_s))
             self.total_requests += 1
 
-    def record_errors(self, n_reqs: int) -> None:
-        """LOGICAL requests failed by the dispatch error path (split
-        chunks count their request once, like every other metric) —
-        without this a failure storm would read as an IDLE engine in
-        serve_stats (no dispatches, no requests) while clients get
-        exceptions."""
+    def record_submitted(self, n: int = 1) -> None:
+        """Offered-load denominator for the windowed drop rate: one per
+        LOGICAL request entering submit(), admitted or not."""
+        now = self.clock()
         with self._lock:
-            self.total_errors += int(n_reqs)
+            self._submit_ts.append((now, int(n)))
+            self._submit_n += int(n)
+            self._trim(now)
+
+    def record_rejected(self, n: int = 1) -> None:
+        """Requests refused at admission (OverloadError from submit —
+        they never queued, so no future carries the failure)."""
+        now = self.clock()
+        with self._lock:
+            self.total_rejected += int(n)
+            self._drop_ts.append((now, int(n)))
+            self._drop_n += int(n)
+            self._trim(now)
+
+    def record_blocked(self, seconds: float) -> None:
+        """Producer time spent blocked for admission (`block` policy) —
+        invisible in latency percentiles (the request had not been
+        submitted yet) but very visible to the caller."""
+        with self._lock:
+            self.blocked_ms_total += float(seconds) * 1e3
+
+    def record_failure(self, exc: BaseException) -> None:
+        """ONE classification point for every exception that resolves a
+        LOGICAL request's future: expiry and shedding are load
+        management (their own counters, and sheds feed the windowed
+        drop rate), anything else is a dispatch error.  Split chunks
+        count their request once — the caller only invokes this for the
+        completion that actually resolved the future, so the population
+        matches every other per-request metric."""
+        now = self.clock()
+        with self._lock:
+            if isinstance(exc, DeadlineExceeded):
+                self.total_expired += 1
+            elif isinstance(exc, SheddedError):
+                self.total_shed += 1
+                self._drop_ts.append((now, 1))
+                self._drop_n += 1
+                self._trim(now)
+            else:
+                self.total_errors += 1
+
+    def drop_stats(self) -> Tuple[float, int]:
+        """Windowed (drop_rate, submitted) — drops are shed + rejected;
+        the rate is over requests submitted in the window.  The
+        engine's `degraded` health threshold reads this per dispatch,
+        so it is O(1): running sums, trim only walks expired entries."""
+        now = self.clock()
+        with self._lock:
+            self._trim(now)
+            submitted, dropped = self._submit_n, self._drop_n
+        return (dropped / submitted if submitted else 0.0), submitted
 
     # ---- reporting -----------------------------------------------------
     def snapshot(self) -> Dict[str, float]:
@@ -86,23 +185,34 @@ class ServingMetrics:
         once), ``rows_per_sec`` (dispatched rows over the window),
         ``batch_occupancy`` (mean rows/bucket fill of dispatched
         batches — 1.0 means every dispatch ran a full bucket),
-        ``queue_depth`` (at the last dispatch), ``dispatch_ms`` (mean
-        device dispatch+fetch wall time) and nearest-rank latency
-        percentiles in ms.  ``per_bucket`` breaks the dispatch wall
-        times down by shape bucket (p50/p95/p99 + counts per bucket):
-        a global mean hides which executables are slow, and the
-        per-shape-bucket medians are exactly what the calibration
-        harvest (``flexflow_tpu.search.calibration
-        .harvest_serve_dispatch``) feeds back into the cost model."""
+        ``queue_depth`` (LIVE when the engine wired ``queue_depth_fn``,
+        else at the last dispatch), ``last_dispatch_age_s`` (stall
+        gauge: None until the first dispatch), ``dispatch_ms`` (mean
+        device dispatch+fetch wall time), nearest-rank latency
+        percentiles in ms, the overload counters
+        (``rejected``/``shed``/``expired``/``admission_blocked_ms``)
+        and ``deadline_p99_ms`` (latency tail of deadlined requests).
+        ``per_bucket`` breaks the dispatch wall times down by shape
+        bucket (p50/p95/p99 + counts per bucket): a global mean hides
+        which executables are slow, and the per-shape-bucket medians
+        are exactly what the calibration harvest
+        (``flexflow_tpu.search.calibration.harvest_serve_dispatch``)
+        feeds back into the cost model."""
         now = self.clock()
+        depth_fn = self.queue_depth_fn
+        live_depth = depth_fn() if depth_fn is not None else None
         with self._lock:
             self._trim(now)
             disp = list(self._dispatches)
             lat_rows = list(self._latencies)
             lats = [l for _, l in lat_rows]
-            depth = self._queue_depth
+            dlats = [l for _, l in self._deadline_lats]
+            depth = self._queue_depth if live_depth is None else live_depth
+            last_t = self._last_dispatch_t
             totals = (self.total_dispatches, self.total_requests,
-                      self.total_rows, self.total_errors)
+                      self.total_rows, self.total_errors,
+                      self.total_rejected, self.total_shed,
+                      self.total_expired, self.blocked_ms_total)
         span = self.window_s
         if disp:
             span = min(self.window_s, max(1e-6, now - disp[0][0]))
@@ -113,6 +223,7 @@ class ServingMetrics:
         rows = sum(d[1] for d in disp)
         occ = (sum(d[1] / d[2] for d in disp) / len(disp)) if disp else 0.0
         q = quantiles(lats)
+        qd = quantiles(dlats)
 
         def ms(v):
             # None, not NaN: json.dumps writes bare `NaN` (invalid
@@ -140,17 +251,24 @@ class ServingMetrics:
             "rows_per_sec": round(rows / span, 3),
             "batch_occupancy": round(occ, 4),
             "queue_depth": depth,
+            "last_dispatch_age_s": (None if last_t is None
+                                    else round(now - last_t, 3)),
             "dispatch_ms": round(
                 sum(d[4] for d in disp) / len(disp) * 1e3, 3) if disp
                 else 0.0,
             "p50_ms": ms(q[0.5]),
             "p95_ms": ms(q[0.95]),
             "p99_ms": ms(q[0.99]),
+            "deadline_p99_ms": ms(qd[0.99]),
             "per_bucket": per_bucket,
             "dispatches": totals[0],
             "requests": totals[1],
             "rows": totals[2],
             "errors": totals[3],
+            "rejected": totals[4],
+            "shed": totals[5],
+            "expired": totals[6],
+            "admission_blocked_ms": round(totals[7], 3),
         }
 
     def emit(self, extra: Dict | None = None) -> None:

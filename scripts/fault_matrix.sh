@@ -23,6 +23,11 @@ declare -a cases=(
   # bit-identical against fixed-mesh references (docs/elastic.md
   # "Resharding"; single-process, 8 virtual CPU devices — tier-1 speed)
   "$FAST_TIMEOUT tests/test_reshard.py"
+  # serve_slow_dispatch / serve_fail_dispatch / serve_queue_spike: the
+  # serving-side fault kinds driven through the ServingEngine's
+  # dispatcher (docs/serving.md "Overload, SLOs & degradation";
+  # in-process, injectable clock/sleep — tier-1 speed)
+  "$FAST_TIMEOUT tests/test_serving.py::TestServeFaults"
 )
 if [ "${1:-}" != "--fast-only" ]; then
   cases+=(

@@ -52,6 +52,16 @@ by tier-1 ``tests/test_static_checks.py``).  Rules:
   serve loop itself — the analogue of RL004's epoch loop); any
   ``float``/``np.asarray``/``jax.device_get`` inside a ``for`` loop
   there is a per-request sync and is rejected.
+* **RL008 — serving code reads time only through the injected clock**
+  (ISSUE 8): a bare ``time.time()``/``time.monotonic()`` call inside
+  ``flexflow_tpu/serving/`` bypasses the ``clock=`` every serving
+  class takes, and the deterministic fake-clock overload/deadline
+  tests rot the moment one sneaks in — the code under test would mix
+  fake and real time.  Default-argument position is exempt (``clock:
+  Callable = time.monotonic`` and friends are the injection point
+  itself), as is ``serving/bench.py`` — the benchmark harness
+  DRIVES real wall-clock runs; it measures the engine, it is not the
+  engine.
 
 Exit 0 when clean, 1 with ``file:line: RLxxx message`` findings on
 stdout.  No third-party deps — must run on a bare CPython.
@@ -100,6 +110,13 @@ _RL004_FUNCS = ("fit", "evaluate", "predict")
 # inside these iterate requests
 _RL005_FUNCS = ("_dispatch_loop", "_dispatch_batch")
 
+# wall-clock reads RL008 bans in flexflow_tpu/serving/ (outside
+# default-argument position): every serving class takes an injectable
+# ``clock=`` — the fake-clock overload tests depend on it being the
+# ONLY time source.  bench.py is exempt (it measures real wall-clock).
+_RL008_BANNED = {"time.time", "time.monotonic"}
+_RL008_EXEMPT = ("flexflow_tpu/serving/bench.py",)
+
 
 # files where hardware-rate literals are the DESIGN (the device model
 # and the calibration table) — exempt from RL007
@@ -127,11 +144,14 @@ class _Visitor(ast.NodeVisitor):
             or relpath == "flexflow_tpu/parallel/sharding.py")
         self.in_tests = relpath.startswith("tests/")
         self.in_serving = relpath.startswith("flexflow_tpu/serving/")
+        self.in_clock_scope = (self.in_serving
+                               and relpath not in _RL008_EXEMPT)
         self.is_mesh_factory = relpath == "flexflow_tpu/parallel/mesh.py"
         self._hot_func: Optional[str] = None  # inside fit/evaluate/predict
         self._batch_loops = 0                 # nested non-epoch loop depth
         self._serve_func: Optional[str] = None  # inside _dispatch_*
         self._req_loops = 0                   # nested for-loop depth there
+        self._default_pos: set = set()        # Call nodes in arg defaults
 
     def _add(self, node: ast.AST, code: str, msg: str) -> None:
         self.findings.append((node.lineno, code, msg))
@@ -144,6 +164,7 @@ class _Visitor(ast.NodeVisitor):
             self._check_rng(node, name)
             self._check_step_sync(node, name)
             self._check_raw_mesh(node, name)
+            self._check_clock(node, name)
         self.generic_visit(node)
 
     def visit_Constant(self, node: ast.Constant) -> None:
@@ -174,8 +195,29 @@ class _Visitor(ast.NodeVisitor):
                       f"reshard path (FFModel.reshard, reshard-on-"
                       f"resume) sees every mesh the repo constructs")
 
+    def _check_clock(self, node: ast.Call, name: str) -> None:
+        if not self.in_clock_scope or name not in _RL008_BANNED:
+            return
+        if id(node) in self._default_pos:
+            # `def f(now=time.monotonic())` evaluates ONCE at def time —
+            # that's the injection-default idiom, not a runtime read
+            return
+        self._add(node, "RL008",
+                  f"bare {name}() in flexflow_tpu/serving/ — serving "
+                  f"code must read time through the injected clock "
+                  f"(clock=...) so the deterministic fake-clock "
+                  f"overload/deadline tests stay honest "
+                  f"(docs/serving.md)")
+
     # --- RL004/RL005 scope tracking -----------------------------------
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # register Call nodes inside argument defaults before walking:
+        # RL008 exempts default-argument position
+        args = node.args
+        for d in list(args.defaults) + [d for d in args.kw_defaults if d]:
+            for sub in ast.walk(d):
+                if isinstance(sub, ast.Call):
+                    self._default_pos.add(id(sub))
         hot = (self.in_library and node.name in _RL004_FUNCS)
         serve = (self.in_serving and node.name in _RL005_FUNCS)
         prev = (self._hot_func, self._batch_loops,

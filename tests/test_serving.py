@@ -18,10 +18,12 @@ import numpy as np
 import pytest
 
 import flexflow_tpu as ff
+from flexflow_tpu import faults
 from flexflow_tpu.parallel.mesh import MachineMesh
-from flexflow_tpu.serving import (MicroBatcher, Request, ServingEngine,
-                                  ServingMetrics, bucket_for, derive_buckets,
-                                  split_sizes)
+from flexflow_tpu.serving import (DeadlineExceeded, MicroBatcher,
+                                  OverloadError, Request, ServingEngine,
+                                  ServingMetrics, SheddedError, bucket_for,
+                                  derive_buckets, split_sizes)
 
 BS = 16
 NFEAT = 12
@@ -160,6 +162,257 @@ def test_submit_all_atomic_after_close():
     with pytest.raises(RuntimeError, match="closed"):
         b.submit_all(chunks)
     assert b.queue_depth == 0 and b.pending_rows == 0
+
+
+# ----------------------------------------------------------------------
+# deadlines: queued work expires BEFORE packing (fake clock, no threads)
+# ----------------------------------------------------------------------
+def _dreq(n, clock, done, deadline=None, priority=0):
+    return Request((np.zeros((n, 1), np.float32),), n,
+                   lambda out, now: done.append((n, out)) or True, clock(),
+                   deadline=deadline, priority=priority)
+
+
+def test_deadline_expires_queued_request_before_packing():
+    clk = FakeClock()
+    b = MicroBatcher(max_batch=8, max_wait_ms=5.0, clock=clk)
+    done = []
+    b.submit(_dreq(3, clk, done, deadline=0.003))
+    assert b.poll() is None and not done   # alive: not due, not expired
+    clk.t = 0.004                          # past the deadline, pre-flush
+    assert b.poll() is None                # expired, NOT dispatched
+    assert len(done) == 1
+    n, out = done[0]
+    assert n == 3 and isinstance(out, DeadlineExceeded)
+    assert b.queue_depth == 0 and b.pending_rows == 0
+    clk.t = 1.0
+    assert b.poll() is None                # nothing left to flush
+
+
+def test_deadline_mixed_expiry_packs_only_survivors():
+    clk = FakeClock()
+    b = MicroBatcher(max_batch=8, max_wait_ms=5.0, clock=clk)
+    done = []
+    b.submit(_dreq(3, clk, done, deadline=0.002))
+    b.submit(_dreq(4, clk, done))          # no deadline
+    clk.t = 0.006                          # flush due AND first expired
+    batch = b.poll()
+    assert [r.n for r in batch] == [4]
+    assert len(done) == 1 and isinstance(done[0][1], DeadlineExceeded)
+
+
+def test_submit_all_empty_is_a_noop_under_every_policy():
+    clk = FakeClock()
+    for policy in ("block", "reject", "shed_oldest"):
+        b = MicroBatcher(max_batch=4, max_wait_ms=1e9, clock=clk,
+                         max_queue_rows=8, admission=policy)
+        done = []
+        b.submit(_dreq(4, clk, done))
+        b.submit(_dreq(4, clk, done))       # full: the shed/reject
+        assert b.submit_all([]) == 0.0      # branches would otherwise run
+        assert b.pending_rows == 8 and not done
+
+
+def test_deadlined_submit_wakes_a_parked_dispatcher():
+    """A request whose deadline precedes the dispatcher's scheduled
+    wake must NOTIFY it: the parked wait was computed before this
+    deadline existed, and without a wake the expiry would fire up to
+    max_wait late instead of AT the deadline (real clock; the consumer
+    is event-driven — the only waiting is on the expiry itself)."""
+    import time as _time
+    b = MicroBatcher(max_batch=8, max_wait_ms=60_000.0)
+    expired = threading.Event()
+
+    def on_done(out, now):
+        if isinstance(out, DeadlineExceeded):
+            expired.set()
+        return True
+
+    consumer = threading.Thread(target=b.next_batch, daemon=True)
+    consumer.start()
+    # park the dispatcher on the 60s flush deadline of a no-deadline
+    # request, then submit one that expires almost immediately
+    b.submit(Request((np.zeros((2, 1), np.float32),), 2,
+                     lambda o, t: True, b.clock()))
+    b.submit(Request((np.zeros((1, 1), np.float32),), 1, on_done,
+                     b.clock(), deadline=b.clock() + 0.01))
+    assert expired.wait(timeout=5), \
+        "deadline expiry waited for the 60s flush instead of the wake"
+    b.close()
+    consumer.join(timeout=5)
+    assert not consumer.is_alive()
+
+
+def test_next_batch_wakes_for_earliest_deadline():
+    """The dispatcher's self-scheduled wake must include queued
+    deadlines: a request whose deadline precedes the flush deadline
+    fails AT its deadline, not whenever the flush happens to look."""
+    clk = FakeClock()
+    b = MicroBatcher(max_batch=8, max_wait_ms=5000.0, clock=clk)
+    done = []
+    b.submit(_dreq(2, clk, done, deadline=0.010))
+    with b._cv:
+        wake = b._wake_in(clk())
+    assert wake == pytest.approx(0.010)    # deadline, not the 5s flush
+
+
+# ----------------------------------------------------------------------
+# admission control: bounded queue, block / reject / shed_oldest
+# ----------------------------------------------------------------------
+def test_admission_reject_fails_fast_and_enqueues_nothing():
+    clk = FakeClock()
+    b = MicroBatcher(max_batch=4, max_wait_ms=1e9, clock=clk,
+                     max_queue_rows=8, admission="reject")
+    done = []
+    b.submit(_dreq(4, clk, done))
+    b.submit(_dreq(4, clk, done))          # bound reached
+    with pytest.raises(OverloadError, match="queue full"):
+        b.submit(_dreq(2, clk, done))
+    assert b.pending_rows == 8 and b.queue_depth == 2
+    # a single logical request bigger than the whole bound can never be
+    # admitted under any policy: reject it up front
+    with pytest.raises(OverloadError, match="exceeds the queue bound"):
+        b.submit_all([_dreq(4, clk, done), _dreq(4, clk, done),
+                      _dreq(4, clk, done)])
+    assert b.pending_rows == 8             # nothing half-enqueued
+
+
+def test_admission_shed_oldest_evicts_and_bounds_queue():
+    clk = FakeClock()
+    b = MicroBatcher(max_batch=4, max_wait_ms=1e9, clock=clk,
+                     max_queue_rows=8, admission="shed_oldest")
+    done = []
+    b.submit(_dreq(4, clk, done))
+    clk.t = 0.001
+    b.submit(_dreq(4, clk, done))
+    clk.t = 0.002
+    b.submit(_dreq(4, clk, done))          # sheds the OLDEST (t=0)
+    assert len(done) == 1
+    n, out = done[0]
+    assert n == 4 and isinstance(out, SheddedError)
+    assert b.pending_rows == 8 and b.peak_rows <= 8
+    # FIFO order of the survivors is preserved
+    b.close()
+    assert [r.t_submit for r in b.poll()] == [0.001]
+    assert [r.t_submit for r in b.poll()] == [0.002]
+
+
+def test_shed_never_displaces_higher_priority_work():
+    clk = FakeClock()
+    b = MicroBatcher(max_batch=4, max_wait_ms=1e9, clock=clk,
+                     max_queue_rows=8, admission="shed_oldest")
+    done = []
+    b.submit(_dreq(4, clk, done, priority=5))
+    b.submit(_dreq(4, clk, done, priority=5))
+    # a low-priority request cannot shed the queued high-priority work:
+    # it is the one refused
+    with pytest.raises(OverloadError, match="higher-priority"):
+        b.submit(_dreq(4, clk, done, priority=0))
+    assert not done and b.pending_rows == 8
+    # ...and a doomed request must not shed eligible victims either,
+    # when the higher-priority remainder would still overflow: here 2
+    # low-priority rows ARE sheddable, but evicting them cannot fit the
+    # incoming 4 rows next to 6 high-priority ones — nothing is evicted
+    b2 = MicroBatcher(max_batch=4, max_wait_ms=1e9, clock=clk,
+                      max_queue_rows=8, admission="shed_oldest")
+    done2 = []
+    b2.submit(_dreq(2, clk, done2, priority=0))
+    b2.submit(_dreq(4, clk, done2, priority=5))
+    b2.submit(_dreq(2, clk, done2, priority=5))
+    with pytest.raises(OverloadError):
+        b2.submit(_dreq(4, clk, done2, priority=0))
+    assert not done2 and b2.pending_rows == 8   # pure-loss shed avoided
+    # an equal-priority request CAN shed the oldest equal-priority one
+    b.submit(_dreq(4, clk, done, priority=5))
+    assert len(done) == 1 and isinstance(done[0][1], SheddedError)
+
+
+def test_admission_block_waits_for_room():
+    clk = FakeClock()
+    b = MicroBatcher(max_batch=4, max_wait_ms=0.0, clock=clk,
+                     max_queue_rows=8, admission="block")
+    done = []
+    b.submit(_dreq(4, clk, done))
+    b.submit(_dreq(4, clk, done))          # full
+    out = {}
+
+    def producer():
+        out["blocked_s"] = b.submit(_dreq(2, clk, done))
+
+    th = threading.Thread(target=producer)
+    th.start()
+    # free room from the consumer side (max_wait 0: always due); the
+    # blocked producer is woken by the take — no sleeps involved
+    taken = []
+    while th.is_alive():
+        got = b.poll()
+        if got:
+            taken.extend(r.n for r in got)
+    th.join(timeout=30)
+    assert not th.is_alive()
+    assert out["blocked_s"] >= 0.0
+    # drain the rest: the late request made it into the queue
+    b.close()
+    while True:
+        got = b.poll()
+        if not got:
+            break
+        taken.extend(r.n for r in got)
+    assert taken[:2] == [4, 4] and 2 in taken
+
+
+def test_fail_pending_clears_everything_for_drain():
+    clk = FakeClock()
+    b = MicroBatcher(max_batch=4, max_wait_ms=1e9, clock=clk)
+    done = []
+    b.submit(_dreq(3, clk, done))
+    clk.t = 0.001
+    b.submit(_dreq(4, clk, done, priority=2))
+    stragglers = b.fail_pending()
+    assert [r.t_submit for r in stragglers] == [0.0, 0.001]  # oldest first
+    assert b.queue_depth == 0 and b.pending_rows == 0
+    assert b.poll() is None
+
+
+# ----------------------------------------------------------------------
+# priority classes: strict order, FIFO within class, aging bound
+# ----------------------------------------------------------------------
+def test_priority_order_fifo_within_class():
+    clk = FakeClock()
+    b = MicroBatcher(max_batch=4, max_wait_ms=1e9, clock=clk,
+                     starvation_ms=0.0)
+    done = []
+    for i, (n, pri) in enumerate([(2, 0), (2, 5), (2, 0), (2, 5)]):
+        clk.t = i * 0.001
+        b.submit(_dreq(n, clk, done, priority=pri))
+    b.close()
+    first = b.poll()
+    second = b.poll()
+    # class 5 served first, FIFO within it; then class 0, FIFO
+    assert [r.t_submit for r in first] == [0.001, 0.003]
+    assert [r.t_submit for r in second] == [0.0, 0.002]
+
+
+def test_anti_starvation_aging_bound_promotes_old_low_priority():
+    clk = FakeClock()
+    b = MicroBatcher(max_batch=2, max_wait_ms=1.0, clock=clk,
+                     starvation_ms=100.0)
+    done = []
+    b.submit(_dreq(2, clk, done, priority=0))      # t=0, low
+    clk.t = 0.150                                  # low now starving
+    b.submit(_dreq(2, clk, done, priority=5))      # fresh high
+    batch = b.poll()
+    assert [r.priority for r in batch] == [0]      # aged class jumps
+    batch = b.poll()
+    assert [r.priority for r in batch] == [5]
+    # without aging, strict priority wins
+    b2 = MicroBatcher(max_batch=2, max_wait_ms=1.0, clock=clk,
+                      starvation_ms=0.0)
+    clk.t = 0.0
+    b2.submit(_dreq(2, clk, done, priority=0))
+    clk.t = 0.150
+    b2.submit(_dreq(2, clk, done, priority=5))
+    assert [r.priority for r in b2.poll()] == [5]
 
 
 # ----------------------------------------------------------------------
@@ -346,6 +599,336 @@ def test_submit_validation():
         ok = eng.submit(np.ones((3, NFEAT), np.float32)).result(timeout=30)
         assert ok.shape == (3, NCLS)
     assert eng.stats()["errors"] == 0
+
+
+# ----------------------------------------------------------------------
+# engine-level overload handling (fake clock where the clock matters)
+# ----------------------------------------------------------------------
+def test_engine_deadline_expires_without_burning_a_dispatch():
+    clk = FakeClock()
+    m = _model()
+    eng = ServingEngine(m, stats_every=0, max_wait_ms=0.0, clock=clk)
+    fut = eng.submit(_requests([3], seed=1)[0], deadline_ms=5.0)
+    clk.t = 0.010                          # deadline long gone
+    eng.start()
+    with pytest.raises(DeadlineExceeded, match="no dispatch burned"):
+        fut.result(timeout=30)
+    snap = eng.stats()
+    assert snap["expired"] == 1 and snap["dispatches"] == 0
+    # the engine keeps serving: an un-deadlined request goes through
+    req = _requests([4], seed=2)[0]
+    out = eng.submit(req).result(timeout=30)
+    eng.stop()
+    np.testing.assert_array_equal(out, m.predict(req, batch_size=BS)[:4])
+    snap = eng.stats()
+    assert snap["requests"] == 1 and snap["expired"] == 1
+
+
+def test_engine_split_request_expiry_is_atomic():
+    """Partial expiry of a split oversize request resolves the logical
+    future ONCE with DeadlineExceeded, counts ONE expired request, and
+    the surviving sibling chunks are dropped before packing — zero
+    dispatches burned on a request nobody is waiting on."""
+    clk = FakeClock()
+    m = _model()
+    eng = ServingEngine(m, stats_every=0, max_batch=4, max_wait_ms=0.0,
+                        clock=clk)
+    fut = eng.submit(_requests([10], seed=3)[0], deadline_ms=5.0)
+    clk.t = 0.010
+    eng.start()
+    with pytest.raises(DeadlineExceeded):
+        fut.result(timeout=30)
+    snap = eng.stats()
+    assert snap["expired"] == 1            # logical request, not chunks
+    assert snap["dispatches"] == 0         # no sibling burned a dispatch
+    eng.stop()
+
+
+def test_engine_reject_policy_raises_overload_and_counts():
+    m = _model()
+    eng = ServingEngine(m, stats_every=0, max_batch=4, max_wait_ms=1e6,
+                        max_queue_rows=8, admission="reject")
+    reqs = _requests([4, 4, 2], seed=4)
+    futs = [eng.submit(r) for r in reqs[:2]]   # queued: bound reached
+    with pytest.raises(OverloadError, match="rejected"):
+        eng.submit(reqs[2])
+    assert eng.stats()["rejected"] == 1
+    eng.start()
+    outs = [f.result(timeout=30) for f in futs]  # queued work still serves
+    eng.stop()
+    want = m.predict(np.concatenate(reqs[:2]), batch_size=BS)
+    np.testing.assert_array_equal(np.concatenate(outs), want[:8])
+    snap = eng.stats()
+    assert snap["requests"] == 2 and snap["rejected"] == 1
+
+
+def test_engine_shed_oldest_policy_fails_oldest_future():
+    m = _model()
+    eng = ServingEngine(m, stats_every=0, max_batch=4, max_wait_ms=1e6,
+                        max_queue_rows=8, admission="shed_oldest")
+    reqs = _requests([4, 4, 4], seed=5)
+    doomed = eng.submit(reqs[0])
+    kept = eng.submit(reqs[1])
+    newest = eng.submit(reqs[2])           # sheds `doomed`
+    with pytest.raises(SheddedError, match="shed after queueing"):
+        doomed.result(timeout=5)
+    eng.start()
+    out1 = kept.result(timeout=30)
+    out2 = newest.result(timeout=30)
+    eng.stop()
+    np.testing.assert_array_equal(
+        out1, m.predict(reqs[1], batch_size=BS)[:4])
+    np.testing.assert_array_equal(
+        out2, m.predict(reqs[2], batch_size=BS)[:4])
+    snap = eng.stats()
+    assert snap["shed"] == 1 and snap["requests"] == 2
+    assert snap["peak_queue_rows"] <= 8
+
+
+def test_engine_drain_not_started_fails_stragglers_typed():
+    m = _model()
+    eng = ServingEngine(m, stats_every=0)
+    futs = [eng.submit(r) for r in _requests([3, 4], seed=6)]
+    assert eng.health == "starting"
+    snap = eng.drain(timeout=0)
+    for f in futs:
+        with pytest.raises(SheddedError, match="drained"):
+            f.result(timeout=5)
+    assert snap["shed"] == 2
+    assert eng.health == "stopped"
+    # draining stopped admissions for good — and the refusal is the
+    # TYPED admission error, so `except ServingError` clients catch it
+    with pytest.raises(OverloadError, match="not admitting"):
+        eng.submit(_requests([2], seed=7)[0])
+
+
+def test_engine_drain_flushes_queue_then_stops():
+    m = _model()
+    # max_wait so large the queue only ever flushes because drain
+    # closed the batcher — the flush is drain's doing, not the timer's
+    eng = ServingEngine(m, stats_every=0, max_wait_ms=1e6)
+    eng.start()
+    req = _requests([5], seed=8)[0]
+    fut = eng.submit(req)
+    snap = eng.drain(timeout=30)
+    np.testing.assert_array_equal(
+        fut.result(timeout=5), m.predict(req, batch_size=BS)[:5])
+    assert snap["requests"] == 1 and snap["shed"] == 0
+    assert eng.health == "stopped"
+    # idempotent: a second drain/stop is a no-op
+    eng.drain(timeout=0)
+    eng.stop()
+
+
+def test_engine_health_walks_degraded_and_recovers(capsys):
+    m = _model()
+    eng = ServingEngine(m, stats_every=0, degraded_after_errors=2)
+    assert eng.health == "starting"
+    boom = {"left": 2}
+    orig = m.forward_compiled
+
+    def flaky(bucket):
+        if boom["left"] > 0:
+            boom["left"] -= 1
+            raise RuntimeError("injected dispatch failure")
+        return orig(bucket)
+
+    m.forward_compiled = flaky
+    try:
+        eng.start()
+        assert eng.health == "serving"
+        r1, r2, r3 = _requests([2, 3, 4], seed=9)
+        with pytest.raises(RuntimeError):
+            eng.submit(r1).result(timeout=30)
+        assert eng.health == "serving"      # one error < threshold
+        with pytest.raises(RuntimeError):
+            eng.submit(r2).result(timeout=30)
+        assert eng.health == "degraded"     # 2 consecutive errors
+        out = eng.submit(r3).result(timeout=30)
+        assert eng.health == "serving"      # success resets the streak
+    finally:
+        m.forward_compiled = orig
+        eng.stop()
+    assert eng.health == "stopped"
+    np.testing.assert_array_equal(
+        out, m.predict(r3, batch_size=BS)[:4])
+    events = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+              if l.startswith("{")]
+    health = [(e["prev"], e["state"]) for e in events
+              if e.get("event") == "serve_health"]
+    assert ("serving", "degraded") in health
+    assert ("degraded", "serving") in health
+    assert health[-1][1] == "stopped"
+
+
+def test_engine_stats_report_live_queue_depth():
+    """The wedged-dispatcher bug: depth used to freeze at the LAST
+    dispatch, so a stalled engine behind a growing queue looked
+    healthy.  stats() must report the batcher's live count."""
+    m = _model()
+    eng = ServingEngine(m, stats_every=0)   # not started: no dispatches
+    for r in _requests([2, 3, 4], seed=10):
+        eng.submit(r)
+    snap = eng.stats()
+    assert snap["queue_depth"] == 3         # live, despite 0 dispatches
+    assert snap["last_dispatch_age_s"] is None
+    eng.start()
+    # served: the live view drains back to 0
+    while eng.stats()["requests"] < 3:
+        pass
+    assert eng.stats()["queue_depth"] == 0
+    assert eng.stats()["last_dispatch_age_s"] is not None
+    eng.stop()
+
+
+def test_metrics_last_dispatch_age_tracks_stall():
+    clk = FakeClock()
+    sm = ServingMetrics(window_s=100.0, clock=clk,
+                        queue_depth_fn=lambda: 7)
+    assert sm.snapshot()["last_dispatch_age_s"] is None
+    sm.record_dispatch(rows=4, bucket=4, n_reqs=1, queue_depth=0,
+                       dispatch_s=0.001)
+    clk.t = 5.0
+    snap = sm.snapshot()
+    assert snap["last_dispatch_age_s"] == pytest.approx(5.0)
+    assert snap["queue_depth"] == 7         # live fn wins over last-dispatch
+    json.dumps(snap)                        # still one parseable line
+
+
+def test_submit_names_input_on_uncoercible_payload():
+    m = _model()
+    eng = ServingEngine(m, stats_every=0)
+    # ragged rows: np.array would raise its opaque inhomogeneous-shape
+    # error; the engine must name the input and the expected dtype
+    with pytest.raises(ValueError, match=r"input 0: cannot coerce"):
+        eng.submit([[1.0] * NFEAT, [2.0]])
+    # ...and a wrong trailing shape names the input index too
+    with pytest.raises(ValueError, match=r"input 0: request rows"):
+        eng.submit(np.zeros((2, NFEAT + 1), np.float32))
+    eng.stop()
+
+
+def test_engine_deadline_latency_tracked_separately():
+    m = _model()
+    with ServingEngine(m, stats_every=0) as eng:
+        eng.submit(_requests([3], seed=12)[0],
+                   deadline_ms=60_000.0).result(timeout=30)
+        eng.submit(_requests([2], seed=13)[0]).result(timeout=30)
+    snap = eng.stats()
+    assert snap["requests"] == 2
+    assert snap["deadline_p99_ms"] is not None  # the deadlined one
+    assert snap["expired"] == 0
+
+
+# ----------------------------------------------------------------------
+# FF_FAULT serving kinds (scripts/fault_matrix.sh runs this class)
+# ----------------------------------------------------------------------
+class TestServeFaults:
+    @pytest.fixture
+    def arm(self, monkeypatch):
+        def _arm(spec):
+            monkeypatch.setenv("FF_FAULT", spec)
+            faults.reset()
+        yield _arm
+        monkeypatch.delenv("FF_FAULT", raising=False)
+        faults.reset()
+
+    def test_parse_serve_kinds(self):
+        specs = faults.parse_faults(
+            "serve_slow_dispatch:3,ms=20;serve_fail_dispatch:2,every=4;"
+            "serve_queue_spike:1,rows=128")
+        assert [s.kind for s in specs] == ["serve_slow_dispatch",
+                                          "serve_fail_dispatch",
+                                          "serve_queue_spike"]
+        assert specs[0].extras["ms"] == "20"
+        assert specs[1].extras["every"] == "4"
+        assert specs[2].extras["rows"] == "128"
+        with pytest.raises(ValueError, match=">= 1"):
+            faults.parse_faults("serve_queue_spike:1,rows=0")
+        with pytest.raises(ValueError, match=">= 0"):
+            # a negative stall would convert slow dispatches into
+            # dispatch FAILURES at fire time (sleep raises) — fail at
+            # parse, like every other qualifier
+            faults.parse_faults("serve_slow_dispatch:1,ms=-5")
+        with pytest.raises(ValueError, match="integer"):
+            faults.parse_faults("serve_fail_dispatch:soon")
+
+    def test_serve_fail_dispatch_fails_batch_and_recovers(self, arm):
+        arm("serve_fail_dispatch:1")
+        m = _model()
+        eng = ServingEngine(m, stats_every=0)
+        doomed = eng.submit(_requests([3], seed=20)[0])
+        eng.start()
+        with pytest.raises(RuntimeError,
+                           match="injected serve dispatch failure"):
+            doomed.result(timeout=30)
+        req = _requests([4], seed=21)[0]
+        out = eng.submit(req).result(timeout=30)   # fault spent: serves
+        eng.stop()
+        np.testing.assert_array_equal(
+            out, m.predict(req, batch_size=BS)[:4])
+        snap = eng.stats()
+        assert snap["errors"] == 1 and snap["requests"] == 1
+
+    def test_serve_slow_dispatch_uses_injected_sleep(self, arm):
+        arm("serve_slow_dispatch:2,ms=7")
+        stalls = []
+        m = _model()
+        eng = ServingEngine(m, stats_every=0, max_wait_ms=0.0,
+                            sleep=stalls.append)
+        with eng:
+            for s in (2, 3, 4):               # three separate dispatches
+                eng.submit(_requests([s], seed=s)[0]).result(timeout=30)
+        assert stalls == [0.007, 0.007]       # first N dispatches only
+        assert eng.stats()["dispatches"] == 3
+
+    def test_serve_queue_spike_exercises_admission(self, arm):
+        arm("serve_queue_spike:0,rows=12")
+        m = _model()
+        eng = ServingEngine(m, stats_every=0, max_batch=4,
+                            max_wait_ms=0.0, max_queue_rows=8,
+                            admission="shed_oldest")
+        req = _requests([2], seed=22)[0]
+        fut = eng.submit(req)
+        eng.start()
+        out = fut.result(timeout=30)          # client request survives
+        eng.stop()                            # drains the spike rows
+        np.testing.assert_array_equal(
+            out, m.predict(req, batch_size=BS)[:2])
+        snap = eng.stats()
+        assert snap["requests"] == 1          # spike rows are not clients
+        # the 12-row spike overflowed the 8-row bound through the real
+        # admission path: the bound held and at least one spike chunk
+        # was shed
+        assert snap["peak_queue_rows"] <= 8
+        assert snap["shed"] >= 1
+
+
+# ----------------------------------------------------------------------
+# overload sweep smoke (the artifact shape serve-bench --overload writes)
+# ----------------------------------------------------------------------
+def test_serve_overload_bench_smoke():
+    from flexflow_tpu.fflogger import silenced
+    from flexflow_tpu.serving.bench import run_overload_bench
+    with silenced("ff", "serve"):
+        payload = run_overload_bench(
+            requests=32, rows_lo=1, rows_hi=4, max_batch=8, hidden=32,
+            cell_seconds=0.2, mults=(2.0,),
+            policies=("fifo", "shed_oldest"))
+    assert payload["bench"] == "serve-overload"
+    assert payload["capacity"]["qps_requests"] > 0
+    assert len(payload["cells"]) == 2
+    for cell in payload["cells"]:
+        # every submitted request accounted for exactly once
+        assert cell["reconciled"], cell
+        for key in ("policy", "admission", "deadline_ms", "device_kind",
+                    "calibration_digest", "goodput_rows_per_s",
+                    "rejected", "shed", "expired", "peak_queue_rows"):
+            assert key in cell, key
+    shed_cell = [c for c in payload["cells"]
+                 if c["policy"] == "shed_oldest"][0]
+    assert shed_cell["peak_queue_rows"] <= shed_cell["max_queue_rows"]
+    json.dumps(payload)
 
 
 # ----------------------------------------------------------------------

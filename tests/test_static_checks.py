@@ -220,6 +220,32 @@ def test_static_checks_script_passes_on_repo():
      "def f(devs):\n"
      "    return Mesh(devs, ('x',))\n",
      None),
+    # RL008: serving code reads time ONLY through the injected clock —
+    # a bare wall-clock call would rot the fake-clock overload tests
+    ("flexflow_tpu/serving/zz_bad_clock.py",
+     "import time\n\ndef age(self):\n    return time.monotonic() - self.t0\n",
+     "RL008"),
+    ("flexflow_tpu/serving/zz_bad_clock2.py",
+     "import time\nT0 = time.time()\n",
+     "RL008"),
+    # default-argument position is the injection idiom, not a runtime
+    # read (evaluated once at def time)
+    ("flexflow_tpu/serving/zz_ok_clock_default.py",
+     "import time\n\ndef f(t0=time.monotonic()):\n    return t0\n",
+     None),
+    # ...and referencing the function (no call) as the injectable
+    # default is the standard clock= signature
+    ("flexflow_tpu/serving/zz_ok_clock_ref.py",
+     "import time\n\ndef f(clock=time.monotonic):\n    return clock()\n",
+     None),
+    # the bench harness measures real wall-clock runs: exempt
+    ("flexflow_tpu/serving/bench.py",
+     "import time\n\ndef t():\n    return time.monotonic()\n",
+     None),
+    # outside flexflow_tpu/serving/ the rule does not engage
+    ("flexflow_tpu/zz_ok_clock_elsewhere.py",
+     "import time\n\ndef t():\n    return time.time()\n",
+     None),
     # RL007: hardware-rate literals (bytes/s, FLOP/s band) in op/search
     # code are fossilized calibration numbers — they belong in
     # cost_model.DeviceSpec or the CalibrationTable (ISSUE 7)
