@@ -14,14 +14,22 @@ never cost a split the executor silently replicates.  Entry points:
 """
 
 from .diagnostics import (CODES, Diagnostic, DiagnosticReport, Severity,
-                          VerificationError, make)
+                          VerificationError, make, validate_report_json)
 from .legality import config_diagnostics, degree_executable, per_dim_degrees
-from .verifier import (drain_replicate_fallbacks, record_replicate_fallback,
-                       verify, verify_compile)
+from .sharding_passes import (comm_plan_digest, comm_plan_digest_for_model,
+                              communication_plan, explain_report,
+                              predict_fallbacks, propagate_specs,
+                              render_explain_text, validate_explain_json)
+from .verifier import (drain_fallback_sites, drain_replicate_fallbacks,
+                       record_replicate_fallback, verify, verify_compile)
 
 __all__ = [
     "CODES", "Diagnostic", "DiagnosticReport", "Severity",
     "VerificationError", "make", "config_diagnostics", "degree_executable",
     "per_dim_degrees", "verify", "verify_compile",
     "record_replicate_fallback", "drain_replicate_fallbacks",
+    "drain_fallback_sites", "predict_fallbacks", "propagate_specs",
+    "communication_plan", "comm_plan_digest", "comm_plan_digest_for_model",
+    "explain_report", "render_explain_text", "validate_explain_json",
+    "validate_report_json",
 ]

@@ -51,6 +51,10 @@ CODES: Dict[str, tuple] = {
     "FF110": (Severity.WARN, "strategy entry names no op in the graph"),
     "FF111": (Severity.INFO, "non-canonical device_ids (mesh-linearized)"),
     "FF112": (Severity.ERROR, "strategy needs more devices than the machine"),
+    # static sharding-propagation passes (ISSUE 9)
+    "FF120": (Severity.WARN, "predicted trace-time replicate fallback"),
+    "FF121": (Severity.WARN,
+              "liveness HBM high-water exceeds the budget"),
 }
 
 
@@ -150,6 +154,50 @@ class DiagnosticReport:
         return json.dumps(
             {"diagnostics": [d.to_dict() for d in self.diagnostics],
              "counts": self.counts()}, indent=2)
+
+
+def validate_report_json(obj) -> List[str]:
+    """Schema check for a ``render_json()`` report (the
+    ``flexflow-tpu lint --json`` payload the repo static gate validates
+    over the shipped example strategies).  Returns problem strings —
+    empty means valid."""
+    probs: List[str] = []
+    if not isinstance(obj, dict):
+        return ["report must be an object"]
+    diags = obj.get("diagnostics")
+    if not isinstance(diags, list):
+        probs.append("diagnostics: want a list")
+        diags = []
+    for d in diags:
+        if not isinstance(d, dict):
+            probs.append(f"diagnostic must be an object, got {d!r}")
+            continue
+        code = d.get("code")
+        if code not in CODES:
+            probs.append(f"unknown code {code!r}")
+        if d.get("severity") not in ("INFO", "WARN", "ERROR"):
+            probs.append(f"{code}: bad severity {d.get('severity')!r}")
+        for key in ("op", "message", "hint"):
+            if not isinstance(d.get(key), str):
+                probs.append(f"{code}: {key} must be a string")
+        if not (isinstance(d.get("count"), int) and d["count"] >= 1):
+            probs.append(f"{code}: count must be a positive int")
+    counts = obj.get("counts")
+    if not isinstance(counts, dict):
+        probs.append("counts: want an object")
+    else:
+        for sev, n in counts.items():
+            if sev not in ("INFO", "WARN", "ERROR") \
+                    or not isinstance(n, int):
+                probs.append(f"counts[{sev!r}]: bad entry")
+        got = {}
+        for d in diags:
+            if isinstance(d, dict):
+                got[d.get("severity")] = got.get(d.get("severity"), 0) + 1
+        if got != counts:
+            probs.append(f"counts {counts} disagree with diagnostics "
+                         f"{got}")
+    return probs
 
 
 class VerificationError(ValueError):

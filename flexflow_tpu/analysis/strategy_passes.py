@@ -85,16 +85,45 @@ def memory_diagnostics(layers: List[Op],
                     sparse_tables=sparse_tables)
     peak = sim.peak_memory_bytes(layers, strategies, mesh_shape,
                                  assume_remat=False) * factor
+    # the liveness timeline (Simulator.memory_timeline): same
+    # components, interval analysis on top — its high-water is >= the
+    # scalar sum by construction, and it NAMES the peak (FF121).  The
+    # FF108 gate stays pinned to the scalar the search's inf gate uses,
+    # so lint gating and search legality cannot disagree; FF121 (WARN)
+    # reports the strictly-stronger liveness bound with the offending
+    # interval when IT overflows.
+    tl = sim.memory_timeline(layers, strategies, mesh_shape,
+                             assume_remat=False)
+    diags: List[Diagnostic] = []
     if peak > spec.hbm_capacity:
-        return [make(
+        owners = ", ".join(o["op"] for o in tl["peak_owners"][:3]) \
+            or "(parameter state)"
+        diags.append(make(
             "FF108", "",
             f"estimated per-device peak {peak / 1e9:.2f} GB (incl. "
             f"{factor}x compiler-temp factor) exceeds the "
             f"{spec.hbm_capacity / 1e9:.1f} GB HBM budget; the search "
-            f"scores this strategy infeasible (inf)",
+            f"scores this strategy infeasible (inf); largest resident "
+            f"activations: {owners}",
             hint="raise the sharding degrees, shard the optimizer, or "
-                 "lower the batch size")]
-    return []
+                 "lower the batch size"))
+    tl_peak = tl["peak_bytes"] * factor
+    if tl_peak > spec.hbm_capacity:
+        ev = tl["peak_event"]
+        owners = ", ".join(
+            f"{o['op']} ({o['act_bytes'] / 1e6:.1f} MB)"
+            for o in tl["peak_owners"][:3]) or "(parameter state)"
+        diags.append(make(
+            "FF121", ev["op"],
+            f"liveness high-water {tl_peak / 1e9:.2f} GB (incl. "
+            f"{factor}x compiler-temp factor) exceeds the "
+            f"{spec.hbm_capacity / 1e9:.1f} GB HBM budget at the "
+            f"{ev['phase']} of {ev['op']!r} (state "
+            f"{tl['state_bytes'] * factor / 1e9:.2f} GB resident); "
+            f"peak owners: {owners}",
+            hint="re-shard or rematerialize the peak-owning ops first "
+                 "(flexflow-tpu explain shows the full timeline)"))
+    return diags
 
 
 def host_placement_diagnostics(op: Op, pc: ParallelConfig

@@ -86,6 +86,14 @@ def verify(layers: List[Op],
         report.extend(config_diagnostics(op, pc, mesh_shape, num_devices))
         report.extend(host_placement_diagnostics(op, pc))
 
+    # FF120 — the static sharding-propagation pass (ISSUE 9): run the
+    # TRACER's placement functions against a device-free AbstractMesh
+    # and report every replicate fallback the runtime would record as
+    # FF106, before anything executes
+    from .sharding_passes import fallback_prediction_diagnostics
+    report.extend(fallback_prediction_diagnostics(
+        layers, strategies, mesh_shape, num_devices))
+
     if check_memory:
         report.extend(memory_diagnostics(
             layers, strategies, mesh_shape, num_devices, spec=spec,
@@ -159,27 +167,85 @@ def record_replicate_fallback(name: str, dim: int, degree: int,
         _fallbacks[key] = _fallbacks.get(key, 0) + 1
 
 
-def drain_replicate_fallbacks() -> List[Diagnostic]:
-    """Return (and clear) the aggregated FF106 diagnostics — one per
-    distinct fallback site, with the repeat count."""
+def drain_fallback_sites(owned_names=None) -> tuple:
+    """Return (and clear) the raw aggregated fallback records:
+    ``({(name, dim, degree, axis, axis_size, reason): count}, dropped)``.
+    This is the exact site payload the static FF120 prediction
+    (``analysis.sharding_passes.predict_fallbacks``) must reproduce —
+    the cross-validation tests compare these tuples bit-for-bit (below
+    the ``_FALLBACK_SITE_CAP`` of 4096 distinct sites; past it the
+    runtime truncates and reports the ``dropped`` count while the
+    static prediction stays complete).
+
+    ``owned_names`` scopes the drain: the recorder is process-global,
+    so when several models trace in one process a caller passes its own
+    tensor/parameter names and receives ONLY its sites — everything
+    else stays recorded for the owning model's drain (without the
+    filter, model B's first dispatch would absorb model A's sites and
+    mis-attribute them).  The overflow counter cannot be attributed to
+    a model, so scoped drains leave it for the next full drain instead
+    of reporting another model's drops as their own."""
     global _fallback_overflow
     with _fallback_lock:
-        items = sorted(_fallbacks.items())
-        _fallbacks.clear()
-        dropped, _fallback_overflow = _fallback_overflow, 0
+        if owned_names is None:
+            items = dict(sorted(_fallbacks.items()))
+            _fallbacks.clear()
+            dropped, _fallback_overflow = _fallback_overflow, 0
+        else:
+            items = {k: n for k, n in sorted(_fallbacks.items())
+                     if k[0] in owned_names}
+            for k in items:
+                del _fallbacks[k]
+            dropped = 0
+    return items, dropped
+
+
+def has_fallback_records() -> bool:
+    """Lock-free emptiness peek for hot callers (the serving dispatch
+    loop drains after every packed batch): a benign racy read of the
+    dict — a record landing mid-peek is picked up by the next drain.
+    Deliberately ignores the overflow counter: scoped drains leave it
+    (it is unattributable), and counting it here would permanently
+    defeat the steady-state early-exit once the cap was ever hit."""
+    return bool(_fallbacks)
+
+
+def fallback_where(axis, axis_size: int) -> str:
+    """The shared site-location phrase of FF106 (runtime) and FF120
+    (static prediction) messages — one formatter, identical payloads."""
+    return (f"mesh axis {axis!r} (size {axis_size})" if axis
+            else "no mesh axis")
+
+
+def fallback_site_diagnostics(sites: Dict[tuple, int], dropped: int = 0,
+                              code: str = "FF106") -> List[Diagnostic]:
+    """Render raw fallback sites as diagnostics.  ``code`` selects the
+    tense: FF106 'replicated at trace time' (the runtime record) vs
+    FF120 'will replicate at trace time' (the static prediction) — same
+    site/dim/reason payload either way."""
+    verb = ("replicated at trace time" if code == "FF106"
+            else "will replicate at trace time")
+    hint = ("run flexflow-tpu lint to catch this before compile"
+            if code == "FF106"
+            else "use a degree the executor can realize (see FF101/FF105)")
     out = []
     if dropped:
         out.append(make(
-            "FF106", "",
+            code, "",
             f"{dropped} additional fallback record(s) dropped past the "
             f"{_FALLBACK_SITE_CAP}-site cap", count=dropped))
-    for (name, dim, degree, axis, axis_size, reason), n in items:
-        where = (f"mesh axis {axis!r} (size {axis_size})" if axis
-                 else "no mesh axis")
+    for (name, dim, degree, axis, axis_size, reason), n in sorted(
+            sites.items()):
         out.append(make(
-            "FF106", name,
-            f"degree {degree} on dim {dim} replicated at trace time "
-            f"({reason}, {where})",
-            hint="run flexflow-tpu lint to catch this before compile",
-            count=n))
+            code, name,
+            f"degree {degree} on dim {dim} {verb} "
+            f"({reason}, {fallback_where(axis, axis_size)})",
+            hint=hint, count=n))
     return out
+
+
+def drain_replicate_fallbacks() -> List[Diagnostic]:
+    """Return (and clear) the aggregated FF106 diagnostics — one per
+    distinct fallback site, with the repeat count."""
+    sites, dropped = drain_fallback_sites()
+    return fallback_site_diagnostics(sites, dropped, code="FF106")

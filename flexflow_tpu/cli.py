@@ -57,6 +57,10 @@ def main(argv=None) -> None:
     if argv and argv[0] == "lint":
         # static strategy/graph verifier (docs/verifier.md)
         raise SystemExit(lint_main(argv[1:]))
+    if argv and argv[0] == "explain":
+        # device-free sharding/communication/memory report for a
+        # strategy on a mesh you may not own yet (docs/verifier.md)
+        raise SystemExit(explain_main(argv[1:]))
     script = None
     for a in argv:
         if a.endswith(".py"):
@@ -75,6 +79,8 @@ def main(argv=None) -> None:
               "[--out report.json]\n"
               "       flexflow-tpu lint --model NAME [--strategy s.pb] "
               "[--devices N] [--json]\n"
+              "       flexflow-tpu explain --model NAME [--strategy "
+              "s.pb] [--mesh n=4,c=2] [--json]\n"
               "flags (reference model.cc:1221-1289): -e -b --lr --wd -d "
               "--budget --alpha --reshard-budget -s/-import -ll:tpu "
               "-ll:cpu --nodes --profiling --seed --remat "
@@ -188,8 +194,10 @@ def lint_main(argv) -> int:
         try:
             mesh_shape = {k: int(v) for k, v in
                           (kv.split("=") for kv in args.mesh.split(","))}
-        except ValueError:
-            print(f"lint: bad --mesh {args.mesh!r} (want n=4,c=2)",
+            from .parallel.mesh import AbstractMesh
+            AbstractMesh(mesh_shape)  # axis-name/size validation
+        except ValueError as e:
+            print(f"lint: bad --mesh {args.mesh!r} (want n=4,c=2): {e}",
                   file=sys.stderr)
             return 2
 
@@ -223,6 +231,100 @@ def lint_main(argv) -> int:
         check_resharding=not args.no_resharding)
     print(report.render_json() if args.json else report.render_text())
     return 1 if report.errors else 0
+
+
+def explain_main(argv) -> int:
+    """``flexflow-tpu explain --model M --strategy s.pb --mesh n=16,c=4``:
+    the static what-will-the-runtime-do report (docs/verifier.md
+    "explain") — propagated shardings, predicted FF120 replicate
+    fallbacks, the per-edge communication plan (reshard/allgather/
+    allreduce volumes + ``comm_plan_digest``), and the liveness HBM
+    timeline with its peak-owning ops.  Entirely device-free: a
+    64-device mesh spec is explained from a CPU-only machine without
+    allocating a single jax device.  Exit codes: 0 report produced,
+    2 usage/load failure (unlike lint, explain REPORTS — it does not
+    gate; run lint for the pass/fail judgement)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="flexflow-tpu explain",
+        description="device-free sharding / communication / memory "
+                    "report for a strategy (docs/verifier.md)")
+    parser.add_argument("--model", required=True,
+                        help=f"builtin graph: "
+                             f"{', '.join(sorted(_lint_builders()))}")
+    parser.add_argument("--strategy", default="",
+                        help="strategy .pb; omit for the default "
+                             "data-parallel plan")
+    parser.add_argument("--mesh", default="",
+                        help="mesh factorization, e.g. n=16,c=4 "
+                             "(default: inferred from the strategy)")
+    parser.add_argument("--devices", type=int, default=0,
+                        help="machine size (default: mesh product)")
+    parser.add_argument("-b", "--batch-size", type=int, default=64)
+    parser.add_argument("--hbm-gb", type=float, default=0.0,
+                        help="per-chip HBM budget override in GB")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable report on stdout")
+    parser.add_argument("--out", default="",
+                        help="also write the JSON report here")
+    args = parser.parse_args(argv)
+
+    builders = _lint_builders()
+    if args.model not in builders:
+        print(f"explain: unknown model {args.model!r} (have "
+              f"{', '.join(sorted(builders))})", file=sys.stderr)
+        return 2
+    from .config import FFConfig
+    cfg = FFConfig(batch_size=args.batch_size)
+    model = builders[args.model](cfg)
+
+    strategies = None
+    if args.strategy:
+        from .strategy.proto import load_strategy_file
+        try:
+            strategies = load_strategy_file(args.strategy)
+        except (OSError, ValueError) as e:
+            print(f"explain: cannot load {args.strategy}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    mesh_shape = None
+    if args.mesh:
+        try:
+            mesh_shape = {k: int(v) for k, v in
+                          (kv.split("=") for kv in args.mesh.split(","))}
+            from .parallel.mesh import AbstractMesh
+            AbstractMesh(mesh_shape)  # axis-name/size validation
+        except ValueError as e:
+            print(f"explain: bad --mesh {args.mesh!r} (want n=4,c=2): "
+                  f"{e}", file=sys.stderr)
+            return 2
+
+    spec = None
+    if args.hbm_gb > 0:
+        import dataclasses
+
+        from .search.cost_model import spec_for_device
+        spec = dataclasses.replace(spec_for_device(),
+                                   hbm_capacity=args.hbm_gb * 1e9)
+
+    from .analysis import explain_report, render_explain_text
+    rep = explain_report(
+        args.model, model.layers, strategies, mesh_shape=mesh_shape,
+        num_devices=args.devices or None, spec=spec)
+    if args.json:
+        import json as _json
+        text = _json.dumps(rep, indent=2)
+    else:
+        text = render_explain_text(rep)
+    print(text)
+    if args.out:
+        import json as _json
+        with open(args.out, "w") as f:
+            f.write(_json.dumps(rep, indent=2) + "\n")
+        print(f"# wrote {args.out}", file=sys.stderr)
+    return 0
 
 
 def elastic_main(argv) -> int:

@@ -128,6 +128,10 @@ class FFModel:
         # on batch size, both reused across predict()/serving calls
         self._fwd_compiled: Dict[int, Any] = {}
         self._dummy_labels: Dict[int, np.ndarray] = {}
+        # trace-time replicate-fallback sites drained so far (raw
+        # (name, dim, degree, axis, axis_size, reason) tuples — the set
+        # the static FF120 prediction must equal)
+        self.runtime_fallback_sites: set = set()
         self.perf_metrics = metrics_mod.PerfMetrics()
 
     # ------------------------------------------------------------------
@@ -1733,16 +1737,41 @@ class FFModel:
 
     def _surface_runtime_fallbacks(self) -> None:
         """Drain the sharding layer's aggregated replicate-fallback
-        records (FF106) after a step has executed (tracing done) — the
-        trace-time truth the static compile pass could not see (e.g.
-        ``verify="off"``, configs mutated after compile, or parameter
-        dims the per-output static check does not cover).  Appends to
-        ``verify_report`` and logs ONE aggregate line; cheap no-op when
-        nothing fell back."""
-        from .analysis.verifier import drain_replicate_fallbacks
-        diags = drain_replicate_fallbacks()
-        if not diags:
+        records (FF106) after a dispatch has executed (tracing done) —
+        the trace-time truth the static compile pass could not see
+        (e.g. ``verify="off"``, configs mutated after compile).  Called
+        after train steps, AND after the first ``evaluate``/``predict``
+        /serving dispatch — an inference-only session must see its
+        fallbacks too, not just training runs.  Appends to
+        ``verify_report``, accumulates the raw site tuples on
+        ``self.runtime_fallback_sites`` (the set the static FF120
+        prediction must equal — tests/test_sharding_passes.py pins it),
+        and logs ONE aggregate line; cheap no-op when nothing fell
+        back."""
+        from .analysis.verifier import (drain_fallback_sites,
+                                        fallback_site_diagnostics,
+                                        has_fallback_records)
+        if not has_fallback_records():
+            return  # steady-state hot path (per serving dispatch):
+            #         no set building, no global lock
+        # drain only THIS model's sites: the recorder is process-global
+        # and another model tracing in the same process must not have
+        # its fallbacks absorbed (and mis-attributed) here.  Names are
+        # the repo's one identity key (strategies, checkpoints, FF003)
+        # — two models built with IDENTICAL op names are inherently
+        # indistinguishable to the recorder, like everywhere else.
+        cache = getattr(self, "_owned_names_cache", None)
+        if cache is None or cache[0] != len(self.layers):
+            owned = {t.name for op in self.layers for t in op.outputs}
+            owned.update(w.name for op in self.layers
+                         for w in op.weights)
+            cache = (len(self.layers), owned)
+            self._owned_names_cache = cache
+        sites, dropped = drain_fallback_sites(owned_names=cache[1])
+        if not sites and not dropped:
             return
+        self.runtime_fallback_sites.update(sites)
+        diags = fallback_site_diagnostics(sites, dropped, code="FF106")
         report = getattr(self, "verify_report", None)
         if report is not None:
             report.extend(diags)
@@ -2065,6 +2094,10 @@ class FFModel:
             total += hi - lo
             device_sums.append((bloss, sums))
         fetched = jax.device_get(device_sums)  # ONE fetch for the loop
+        # inference-only sessions trace here first: surface any
+        # replicate fallbacks the eval trace recorded (ISSUE 9 — the
+        # old train-step-only drain left evaluate()/predict() blind)
+        self._surface_runtime_fallbacks()
         loss_sum = float(sum(b for b, _ in fetched))
         for _, sums in fetched:
             pm.update(sums)
@@ -2174,6 +2207,9 @@ class FFModel:
                 drain()
                 pending_elems = 0
         drain()
+        # the AOT lowering above is a trace too: surface its replicate
+        # fallbacks for inference-only sessions (ISSUE 9)
+        self._surface_runtime_fallbacks()
         host = [o[:min(n - it * bs, bs)] for it, o in enumerate(host)]
         return np.concatenate(host, axis=0)
 

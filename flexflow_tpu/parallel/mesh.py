@@ -108,56 +108,35 @@ def degree_expressible(axis_size: int, degree: int) -> bool:
     return subset_for_degree(prime_factors(axis_size), degree) is not None
 
 
-class MachineMesh:
-    """A named jax Mesh over the visible devices (or an explicit list).
-
-    Each canonical axis is materialized as its prime-factor *sub-axes*
-    (axis "n" of size 8 -> mesh axes n0,n1,n2 of size 2 each), so an op may
-    shard a dim with ANY divisor degree of the axis size — the mixed
-    per-op degrees of SOAP strategies (reference
-    Op::get_random_parallel_config, model.cc:276-305) map to sub-axis
-    subsets instead of being rejected.  A PartitionSpec entry that names a
-    canonical axis is expanded to all its sub-axes by :meth:`sharding`.
+class _MeshAxes:
+    """The axis MATH shared by :class:`MachineMesh` (trace time, owns a
+    jax Mesh over real devices) and :class:`AbstractMesh` (the static
+    verifier's device-free view): canonical-axis sizes, their prime
+    sub-axis factorization, and the degree -> sub-axis-subset decision
+    (:meth:`axis_spec`).  One implementation means the static sharding
+    pass (``analysis/sharding_passes.py``) and the tracer CANNOT diverge
+    on which degrees are realizable — they literally run the same code.
     """
 
-    def __init__(self, shape: Optional[Dict[str, int]] = None,
-                 devices: Optional[Sequence[jax.Device]] = None):
-        devices = list(devices if devices is not None else jax.devices())
-        sizes = {a: 1 for a in AXES}
-        if shape:
-            for k, v in shape.items():
-                sizes[_ALIAS.get(k, k)] = int(v)
-        used = int(np.prod(list(sizes.values())))
-        if used == 1 and len(devices) > 1 and not shape:
-            sizes["n"] = len(devices)  # default: pure data parallel
-            used = len(devices)
-        if used > len(devices):
-            raise ValueError(f"mesh {sizes} needs {used} devices, "
-                             f"have {len(devices)}")
-        devices = devices[:used]
+    def _init_axes(self, sizes: Dict[str, int]) -> None:
         self.sizes = sizes
         self._subaxes: Dict[str, Tuple[str, ...]] = {}
         self._subfactors: Dict[str, Tuple[int, ...]] = {}
-        names: list = []
-        dims: list = []
         for a in AXES:
             fs = prime_factors(sizes[a]) if sizes[a] > 1 else ()
-            subs = tuple(f"{a}{i}" for i in range(len(fs)))
-            self._subaxes[a] = subs
+            self._subaxes[a] = tuple(f"{a}{i}" for i in range(len(fs)))
             self._subfactors[a] = fs
-            names.extend(subs)
-            dims.extend(fs)
-        if not names:  # single device still needs a valid Mesh
-            names, dims = ["n0"], [1]
-            self._subaxes["n"] = ("n0",)
-            self._subfactors["n"] = (1,)
-        dev_array = np.array(devices).reshape(dims)
-        self.mesh = Mesh(dev_array, tuple(names))
-        self.num_devices = used
+        # the MESH product — distinct from num_devices on an
+        # AbstractMesh whose machine is larger than the mesh
+        self.mesh_product = int(np.prod(list(sizes.values())))
+        self.num_devices = self.mesh_product
 
     @property
     def is_distributed(self) -> bool:
-        return self.num_devices > 1
+        # keyed on the mesh product, NOT the machine size: a {'n': 1}
+        # mesh on an 8-device machine constrains nothing at trace time,
+        # and the static pass must mirror that exactly
+        return self.mesh_product > 1
 
     def axis_size(self, axis: str) -> int:
         return self.sizes[_ALIAS.get(axis, axis)]
@@ -179,6 +158,94 @@ class MachineMesh:
         if idx is None:
             return None
         return tuple(self._subaxes[a][i] for i in idx)
+
+
+class AbstractMesh(_MeshAxes):
+    """A mesh SHAPE without devices — the static verifier's machine view.
+
+    Shares every axis decision with :class:`MachineMesh` via
+    :class:`_MeshAxes` but never touches jax, so a 64-chip mesh spec can
+    be interpreted on a CPU-only laptop (``flexflow-tpu explain``, the
+    FF120 fallback prediction).  ``num_devices`` may exceed the mesh
+    product (a machine bigger than the strategy uses); it never needs to
+    exist."""
+
+    def __init__(self, shape: Optional[Dict[str, int]] = None,
+                 num_devices: Optional[int] = None):
+        sizes = {a: 1 for a in AXES}
+        for k, v in (shape or {}).items():
+            a = _ALIAS.get(k, k)
+            if a not in sizes:
+                # fail like the runtime would, with a better message: a
+                # typo'd axis must not produce a confidently wrong
+                # static report (every canonical axis silently size 1)
+                raise ValueError(
+                    f"unknown mesh axis {k!r} (canonical axes: "
+                    f"{', '.join(AXES)}; aliases: "
+                    f"{', '.join(sorted(_ALIAS))})")
+            sizes[a] = int(v)
+        self._init_axes(sizes)
+        if num_devices is not None:
+            if num_devices < self.num_devices:
+                raise ValueError(
+                    f"mesh {sizes} needs {self.num_devices} devices, "
+                    f"machine has {num_devices}")
+            self.num_devices = int(num_devices)
+
+    def __repr__(self) -> str:
+        live = {a: s for a, s in self.sizes.items() if s > 1}
+        return (f"AbstractMesh({live or {'n': 1}}, "
+                f"devices={self.num_devices})")
+
+
+class MachineMesh(_MeshAxes):
+    """A named jax Mesh over the visible devices (or an explicit list).
+
+    Each canonical axis is materialized as its prime-factor *sub-axes*
+    (axis "n" of size 8 -> mesh axes n0,n1,n2 of size 2 each), so an op may
+    shard a dim with ANY divisor degree of the axis size — the mixed
+    per-op degrees of SOAP strategies (reference
+    Op::get_random_parallel_config, model.cc:276-305) map to sub-axis
+    subsets instead of being rejected.  A PartitionSpec entry that names a
+    canonical axis is expanded to all its sub-axes by :meth:`sharding`.
+    """
+
+    def __init__(self, shape: Optional[Dict[str, int]] = None,
+                 devices: Optional[Sequence[jax.Device]] = None):
+        devices = list(devices if devices is not None else jax.devices())
+        sizes = {a: 1 for a in AXES}
+        if shape:
+            for k, v in shape.items():
+                a = _ALIAS.get(k, k)
+                if a not in sizes:
+                    # same loud failure as AbstractMesh: an unknown axis
+                    # used to die later as an opaque reshape error
+                    raise ValueError(
+                        f"unknown mesh axis {k!r} (canonical axes: "
+                        f"{', '.join(AXES)}; aliases: "
+                        f"{', '.join(sorted(_ALIAS))})")
+                sizes[a] = int(v)
+        used = int(np.prod(list(sizes.values())))
+        if used == 1 and len(devices) > 1 and not shape:
+            sizes["n"] = len(devices)  # default: pure data parallel
+            used = len(devices)
+        if used > len(devices):
+            raise ValueError(f"mesh {sizes} needs {used} devices, "
+                             f"have {len(devices)}")
+        devices = devices[:used]
+        self._init_axes(sizes)
+        names: list = []
+        dims: list = []
+        for a in AXES:
+            names.extend(self._subaxes[a])
+            dims.extend(self._subfactors[a])
+        if not names:  # single device still needs a valid Mesh
+            names, dims = ["n0"], [1]
+            self._subaxes["n"] = ("n0",)
+            self._subfactors["n"] = (1,)
+        dev_array = np.array(devices).reshape(dims)
+        self.mesh = Mesh(dev_array, tuple(names))
+        self.num_devices = self.mesh_product = used
 
     def _expand(self, entry):
         if entry is None:

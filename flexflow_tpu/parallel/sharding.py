@@ -43,9 +43,44 @@ def _record_fallback(name: str, dim: int, degree: int, axis,
     record_replicate_fallback(name, dim, degree, axis, axis_size, reason)
 
 
+def dim_entry(extent: int, dim: int, degree: int, axis, mesh,
+              name: str, on_fallback) -> object:
+    """THE per-dim placement decision: the PartitionSpec entry one
+    logical dim gets for a requested ``degree`` on ``axis``, or None
+    with ``on_fallback(name, dim, degree, axis, axis_size, reason)``
+    fired when the executor replicates instead.  Shared verbatim by the
+    trace-time builders below and the static verifier's sharding pass
+    (``analysis/sharding_passes.py``) — ``mesh`` may be a
+    :class:`~flexflow_tpu.parallel.mesh.MachineMesh` (trace) or a
+    device-free :class:`~flexflow_tpu.parallel.mesh.AbstractMesh`
+    (lint/explain); both answer ``axis_size``/``axis_spec`` with the
+    same :class:`~flexflow_tpu.parallel.mesh._MeshAxes` math, so the
+    static FF120 prediction and the runtime FF106 record cannot
+    diverge."""
+    if degree <= 1:
+        return None
+    size = mesh.axis_size(axis) if axis else 1
+    sub = mesh.axis_spec(axis, degree) if axis else None
+    from ..analysis.legality import degree_executable
+    # the ONE legality predicate (analysis.legality), shared with the
+    # SOAP search and the static verifier; the mesh's own axis_spec
+    # answer is passed in so expressibility is decided (and searched)
+    # exactly once per dim
+    reason = degree_executable(extent, degree, size, axis,
+                               expressible=sub is not None)
+    if reason is not None:
+        on_fallback(name, dim, degree, axis, size, reason)
+        return None
+    return axis if degree == size else sub
+
+
 def output_spec(tensor: Tensor, pc: Optional[ParallelConfig],
-                mesh: MachineMesh) -> PartitionSpec:
-    """PartitionSpec for an op output under its ParallelConfig."""
+                mesh, on_fallback=None) -> PartitionSpec:
+    """PartitionSpec for an op output under its ParallelConfig.
+    ``on_fallback`` overrides the runtime replicate-fallback recorder
+    (FF106) — the static pass passes its own collector."""
+    if on_fallback is None:
+        on_fallback = _record_fallback
     rank = tensor.num_dims
     axes = dim_axis_names(rank)
     if pc is None:
@@ -57,35 +92,22 @@ def output_spec(tensor: Tensor, pc: Optional[ParallelConfig],
     dims = pc.dims
     if len(dims) != rank:
         dims = tuple(dims[:rank]) + (1,) * max(0, rank - len(dims))
-    from ..analysis.legality import degree_executable
-    entries = []
-    for i, (deg, ax) in enumerate(zip(dims, axes)):
-        if deg <= 1:
-            entries.append(None)
-            continue
-        size = mesh.axis_size(ax) if ax else 1
-        sub = mesh.axis_spec(ax, deg) if ax else None
-        # the ONE legality predicate (analysis.legality), shared with the
-        # SOAP search and the static verifier; the mesh's own axis_spec
-        # answer is passed in so expressibility is decided (and searched)
-        # exactly once per dim
-        reason = degree_executable(tensor.shape[i], deg, size, ax,
-                                   expressible=sub is not None)
-        if reason is not None:
-            _record_fallback(tensor.name, i, deg, ax, size, reason)
-            entries.append(None)
-            continue
-        entries.append(ax if deg == size else sub)
+    entries = [dim_entry(tensor.shape[i], i, deg, ax, mesh,
+                         tensor.name, on_fallback)
+               for i, (deg, ax) in enumerate(zip(dims, axes))]
     return PartitionSpec(*entries)
 
 
 def param_spec(param: Parameter, pc: Optional[ParallelConfig],
-               mesh: MachineMesh) -> PartitionSpec:
+               mesh, on_fallback=None) -> PartitionSpec:
     """Weight sharding.  DP weights are replicated (the reference keeps one
     logical weight region with per-replica grads); a channel-parallel op
     shards its weight on ``sharded_dim`` over axis 'c'
     (reference create_linear_weight, model.cc:582-669); pipeline-stacked
-    weights (shard_axis 'p') always shard their stage dim over 'p'."""
+    weights (shard_axis 'p') always shard their stage dim over 'p'.
+    ``on_fallback`` as in :func:`output_spec`."""
+    if on_fallback is None:
+        on_fallback = _record_fallback
     if param.shard_axis in ("p", "e"):
         # stage-stacked (pipeline) / expert-stacked (MoE) weights shard
         # their leading stack dim over the dedicated mesh axis
@@ -118,18 +140,12 @@ def param_spec(param: Parameter, pc: Optional[ParallelConfig],
             c_deg = deg
     if c_deg <= 1:
         return PartitionSpec()
-    from ..analysis.legality import degree_executable
-    sub = mesh.axis_spec("c", c_deg)
-    reason = degree_executable(param.shape[param.sharded_dim], c_deg,
-                               mesh.axis_size("c"), "c",
-                               expressible=sub is not None)
-    if reason is not None:
-        _record_fallback(param.name, param.sharded_dim, c_deg, "c",
-                         mesh.axis_size("c"), reason)
+    entry = dim_entry(param.shape[param.sharded_dim], param.sharded_dim,
+                      c_deg, "c", mesh, param.name, on_fallback)
+    if entry is None:
         return PartitionSpec()
     entries = [None] * len(param.shape)
-    entries[param.sharded_dim] = ("c" if c_deg == mesh.axis_size("c")
-                                  else sub)
+    entries[param.sharded_dim] = entry
     return PartitionSpec(*entries)
 
 

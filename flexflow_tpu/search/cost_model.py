@@ -160,7 +160,41 @@ def op_memory_bytes(op: Op, part_degrees: Tuple[int, ...],
       the ~17-op scale the constant was validated at (saved-residual
       measurement: boundaries alone are ~0.11x at N=17, plus one
       interior's recompute ~0.25x, model 0.49x — conservative).
+
+    Delegates to :func:`op_memory_components` — ONE accounting shared
+    with the liveness timeline (``Simulator.memory_timeline``), so the
+    FF108 scalar bound and the FF121 interval analysis cannot drift.
     """
+    state, act = op_memory_components(
+        op, part_degrees, dtype_bytes=dtype_bytes,
+        opt_slot_bytes=opt_slot_bytes, axes=axes,
+        stack_degrees=stack_degrees, remat=remat, act_scale=act_scale,
+        sparse_tables=sparse_tables)
+    return state + act
+
+
+def op_memory_components(op: Op, part_degrees: Tuple[int, ...],
+                         dtype_bytes: int = 2, opt_slot_bytes: int = 4,
+                         axes: Tuple[str, ...] = (),
+                         stack_degrees: Dict[str, int] | None = None,
+                         remat: bool = False,
+                         act_scale: float | None = None,
+                         sparse_tables=frozenset()) -> Tuple[float, float]:
+    """The two liveness classes of :func:`op_memory_bytes`, separated for
+    the interval analysis (``Simulator.memory_timeline``):
+
+    * ``state_bytes`` — params + grads + optimizer slots: resident for
+      the WHOLE training step (live range = the full interval; donation
+      means the updated copy replaces, never doubles, them);
+    * ``act_bytes`` — the op's retained output activations: live from
+      the op's forward event until its own backward event completes
+      (in reverse topological order an op's backward is the last use of
+      its stored activation — every consumer's backward ran earlier).
+
+    Same accounting, same arguments, same sharding rules as
+    :func:`op_memory_bytes` — that function remains the one-shot sum
+    (``state + act``) the FF108 legality bound and the search's inf
+    gate are pinned to."""
     stack_degrees = stack_degrees or {}
     if act_scale is None:
         act_scale = 0.5 if remat else 1.0
@@ -171,7 +205,7 @@ def op_memory_bytes(op: Op, part_degrees: Tuple[int, ...],
     nparts = 1
     for d in part_degrees:
         nparts *= d
-    total = 0.0
+    state = 0.0
     for w in op.weights:
         if w.name in sparse_tables:
             # sparse-update table (FFModel._sparse_embedding_specs): no
@@ -188,11 +222,12 @@ def op_memory_bytes(op: Op, part_degrees: Tuple[int, ...],
         elif (w.sharded_dim is not None and c_deg > 1
                 and w.shape[w.sharded_dim] % c_deg == 0):
             per_param /= c_deg
-        total += per_param
+        state += per_param
+    act = 0.0
     if op.op_type not in _UNMATERIALIZED_OPS:
         for t in op.outputs:
-            total += act_scale * t.volume * dtype_bytes / max(1, nparts)
-    return total
+            act += act_scale * t.volume * dtype_bytes / max(1, nparts)
+    return state, act
 
 
 def transfer_time(nbytes: float, intra_slice: bool,

@@ -27,7 +27,8 @@ from ..config import FFConfig, ParallelConfig
 from ..op import Op, pad_degrees
 from ..tensor import Tensor
 from .cost_model import (DeviceSpec, allreduce_time, op_compute_time,
-                         op_memory_bytes, spec_for_device, transfer_time)
+                         op_memory_bytes, op_memory_components,
+                         spec_for_device, transfer_time)
 
 
 class SimTask:
@@ -292,6 +293,116 @@ class Simulator:
                                                     if host_placed(pc)
                                                     else self.sparse_tables))
         return total
+
+    def memory_timeline(self, layers: List[Op],
+                        strategies: Dict[str, ParallelConfig],
+                        mesh_shape: Optional[Dict[str, int]] = None,
+                        assume_remat: Optional[bool] = None) -> Dict:
+        """Liveness-based per-device HBM timeline for one training step
+        — the interval analysis behind the FF121 diagnostic and the
+        ``flexflow-tpu explain`` memory report.
+
+        Events are the topological order the executor runs: every op's
+        FORWARD in layer order, then every op's BACKWARD in reverse.
+        Live ranges (``cost_model.op_memory_components``):
+
+        * params + grads + optimizer slots are resident for the whole
+          step (the donated train dispatch updates them in place — the
+          new copy replaces, never doubles, the old one);
+        * an op's retained activation is live from its forward event
+          until its own backward event completes (in reverse topo order
+          that is the LAST use — every consumer's backward ran
+          earlier); under remat the retained fraction is the same
+          ``2/sqrt(N)`` scale the one-shot bound charges;
+        * each backward event additionally holds the incoming output
+          cotangent as a TRANSIENT (full dtype bytes, never
+          remat-discounted — it exists regardless).
+
+        At the forward/backward boundary every retained activation is
+        live at once, so the high-water is >= the one-shot
+        ``peak_memory_bytes`` sum by construction (the first backward's
+        cotangent rides on top) — the timeline strictly strengthens the
+        scalar bound while FF108/search legality stay pinned to the
+        scalar, so lint gating and the search's inf gate cannot
+        disagree.  Returns ``{"events": [...], "state_bytes": ...,
+        "peak_bytes": ..., "peak_event": {...}, "peak_owners": [...]}``
+        — ``peak_owners`` names the largest live contributions at the
+        peak event (the ops to re-shard or rematerialize first)."""
+        from ..ops.linear import host_placed
+        from ..parallel.mesh import dim_axis_names
+        remat = self.remat if assume_remat is None else assume_remat
+        stack = {a: (mesh_shape or {}).get(a, 1) for a in ("e", "p")}
+        act_scale = 1.0
+        if remat:
+            n_mat = max(1, len(layers))
+            act_scale = min(1.0, 2.0 / math.sqrt(n_mat))
+
+        state_total = 0.0
+        acts: Dict[str, float] = {}
+        cotangents: Dict[str, float] = {}
+        for op in layers:
+            pc = strategies.get(op.name)
+            out = op.outputs[0]
+            if pc is None:
+                dims = tuple(ParallelConfig.data_parallel(
+                    min(self.num_devices, out.shape[0]), out.num_dims).dims)
+            else:
+                dims = pad_degrees(pc.dims, out.num_dims)
+            state, act = op_memory_components(
+                op, dims, self.dtype_bytes,
+                opt_slot_bytes=self.opt_slot_bytes,
+                axes=dim_axis_names(out.num_dims), stack_degrees=stack,
+                remat=remat, act_scale=act_scale,
+                sparse_tables=(frozenset() if host_placed(pc)
+                               else self.sparse_tables))
+            state_total += state
+            acts[op.name] = act
+            nparts = 1
+            for d in dims:
+                nparts *= d
+            cotangents[op.name] = sum(
+                t.volume * self.dtype_bytes / max(1, nparts)
+                for t in op.outputs)
+
+        events: List[Dict] = []
+        live_acts = 0.0
+        live_set: List[str] = []
+        peak = state_total
+        peak_idx = -1
+        peak_live: List[str] = []
+        for op in layers:  # forward sweep
+            live_acts += acts[op.name]
+            live_set.append(op.name)
+            total = state_total + live_acts
+            events.append({"op": op.name, "phase": "fwd",
+                           "live_bytes": total, "transient_bytes": 0.0})
+            if total > peak:
+                peak, peak_idx, peak_live = total, len(events) - 1, \
+                    list(live_set)
+        for op in reversed(layers):  # backward sweep
+            trans = cotangents[op.name]
+            total = state_total + live_acts + trans
+            events.append({"op": op.name, "phase": "bwd",
+                           "live_bytes": total, "transient_bytes": trans})
+            if total > peak:
+                peak, peak_idx, peak_live = total, len(events) - 1, \
+                    list(live_set)
+            live_acts -= acts[op.name]  # own backward: last use, dies
+            if live_set and live_set[-1] == op.name:
+                live_set.pop()
+        owners = sorted(((name, acts[name]) for name in peak_live
+                         if acts[name] > 0),
+                        key=lambda kv: (-kv[1], kv[0]))[:5]
+        peak_event = events[peak_idx] if 0 <= peak_idx < len(events) else {
+            "op": "", "phase": "state", "live_bytes": state_total,
+            "transient_bytes": 0.0}
+        return {
+            "state_bytes": state_total,
+            "events": events,
+            "peak_bytes": peak,
+            "peak_event": dict(peak_event),
+            "peak_owners": [{"op": n, "act_bytes": b} for n, b in owners],
+        }
 
     def _warn_remat_legality(self) -> None:
         """One-shot warning when a remat=True simulator scores a strategy

@@ -180,19 +180,19 @@ class MicroBatcher:
         self._cv = threading.Condition()
         # priority class -> FIFO deque (ONE class 0 deque in the default
         # path — identical semantics to the plain FIFO this replaced)
-        self._classes: Dict[int, deque] = {}
-        self._rows = 0
-        self._count = 0
-        self._watch = 0       # queued requests carrying deadline/stale
-        self._peak_rows = 0
+        self._classes: Dict[int, deque] = {}  # guarded_by: self._cv
+        self._rows = 0        # guarded_by: self._cv
+        self._count = 0       # guarded_by: self._cv
+        self._watch = 0       # guarded_by: self._cv
+        self._peak_rows = 0   # guarded_by: self._cv
         # the absolute time the dispatcher's current cv.wait will
         # self-expire, while it is parked in next_batch (-inf while it
         # is awake or absent): submit only needs to wake it for an
         # incoming DEADLINE that precedes this — notifying on every
         # deadlined submit would re-introduce the per-submit GIL
         # ping-pong the state-change-only notify below exists to avoid
-        self._armed_wake = float("-inf")
-        self._closed = False
+        self._armed_wake = float("-inf")  # guarded_by: self._cv
+        self._closed = False  # guarded_by: self._cv
 
     # ---- producer side -------------------------------------------------
     def submit(self, req: Request) -> float:
@@ -299,8 +299,8 @@ class MicroBatcher:
             raise overload
         return blocked_s
 
-    def _evict_for(self, need_rows: int, incoming_priority: int
-                   ) -> List[Request]:
+    def _evict_for(self, need_rows: int,  # guarded_by: self._cv
+                   incoming_priority: int) -> List[Request]:
         """shed_oldest eviction (lock held): pop the oldest request of
         the LOWEST priority class not above the incoming request's —
         shedding never displaces strictly higher-priority work — until
@@ -357,33 +357,36 @@ class MicroBatcher:
     @property
     def queue_depth(self) -> int:
         """Pending requests (live snapshot, for metrics)."""
-        return self._count
+        with self._cv:
+            return self._count
 
     @property
     def pending_rows(self) -> int:
-        return self._rows
+        with self._cv:
+            return self._rows
 
     @property
     def peak_rows(self) -> int:
         """High-water mark of queued rows over the batcher's lifetime —
         the bounded-queue evidence serve-bench's overload sweep records
         (must stay <= max_queue_rows when the bound is set)."""
-        return self._peak_rows
+        with self._cv:
+            return self._peak_rows
 
-    def _unlink(self, r: Request) -> None:
+    def _unlink(self, r: Request) -> None:  # guarded_by: self._cv
         """Accounting for a request leaving the queue (lock held)."""
         self._rows -= r.n
         self._count -= 1
         if r._watched:
             self._watch -= 1
 
-    def _oldest_t(self) -> Optional[float]:
+    def _oldest_t(self) -> Optional[float]:  # guarded_by: self._cv
         """Submit time of the oldest queued request (lock held) — class
         heads are each class's oldest, so the min over heads is global."""
         return min((dq[0].t_submit for dq in self._classes.values() if dq),
                    default=None)
 
-    def _ready(self, now: float) -> bool:
+    def _ready(self, now: float) -> bool:  # guarded_by: self._cv
         if not self._count:
             return False
         if self._rows >= self.max_batch:
@@ -391,7 +394,8 @@ class MicroBatcher:
         oldest = self._oldest_t()
         return oldest is not None and now - oldest >= self.max_wait_s
 
-    def _collect_expired(self, now: float) -> List[Request]:
+    def _collect_expired(self, now: float  # guarded_by: self._cv
+                         ) -> List[Request]:
         """Remove deadline-expired and stale requests (lock held) and
         return the EXPIRED ones — the caller fires their ``on_done``
         with DeadlineExceeded outside the lock.  Stale entries (logical
@@ -443,7 +447,7 @@ class MicroBatcher:
                 f"queued (waited {now - r.t_submit:.3f}s; expired before "
                 f"packing, no dispatch burned)"), now)
 
-    def _class_order(self, now: float) -> List[int]:
+    def _class_order(self, now: float) -> List[int]:  # guarded_by: self._cv
         """Service order over priority classes (lock held): higher
         class first, EXCEPT that starving classes — oldest request
         waiting >= starvation_ms — jump ahead, oldest-first.  The aging
@@ -462,7 +466,7 @@ class MicroBatcher:
                       reverse=True)
         return starving + rest
 
-    def _take(self, now: float) -> List[Request]:
+    def _take(self, now: float) -> List[Request]:  # guarded_by: self._cv
         """Pop a coalesced batch of at most ``max_batch`` rows (lock
         held): classes in `_class_order`, a FIFO prefix within each
         class (whole requests only — order-preserving, and the scatter
@@ -503,7 +507,7 @@ class MicroBatcher:
                 return batch
             self._fire_expired(fire)
 
-    def _wake_in(self, now: float) -> Optional[float]:
+    def _wake_in(self, now: float) -> Optional[float]:  # guarded_by: self._cv
         """Seconds until the next self-scheduled event (lock held):
         the oldest request's flush deadline, and — when deadlines are
         queued — the earliest expiry (an expired future must fail at

@@ -376,10 +376,12 @@ def run_overload_bench(requests: int = 512, rows_lo: int = 1,
     if fifo2 and shed2:
         summary["goodput_2x_fifo_rows_per_s"] = fifo2["goodput_rows_per_s"]
         summary["fifo_2x_peak_queue_rows"] = fifo2["peak_queue_rows"]
+    from ..analysis import comm_plan_digest_for_model
     return {
         "bench": "serve-overload",
         "backend": jax.default_backend(),
         "device_kind": dk,
+        "comm_plan_digest": comm_plan_digest_for_model(model),
         "estimator": "measured",
         "config": {
             "requests_pool": requests, "rows": f"{rows_lo}-{rows_hi}",
@@ -445,11 +447,16 @@ def run_serve_bench(requests: int = 512, rows_lo: int = 1, rows_hi: int = 8,
     n_paced = min(n_paced, int(rate * 4) + 1)
     paced_row = _run_paced(model, reqs[:n_paced], rate, burst, seed)
 
+    from ..analysis import comm_plan_digest_for_model
     from ..search.calibration import device_kind as _device_kind
     return {
         "bench": "serve-bench",
         "backend": jax.default_backend(),
         "device_kind": _device_kind(),
+        # which sharding/communication plan served these rows (the
+        # static plan digest from flexflow-tpu explain): rows measured
+        # under different plans are different populations
+        "comm_plan_digest": comm_plan_digest_for_model(model),
         "estimator": "measured",  # real engine run, not a sim estimate
         "config": {
             "requests": requests, "rows": f"{rows_lo}-{rows_hi}",

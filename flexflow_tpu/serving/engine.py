@@ -196,16 +196,25 @@ class ServingEngine:
         # engine deliberately keeps no snapshot of its own
         for b in self.buckets:
             model.forward_compiled(b)
-        self._thread: Optional[threading.Thread] = None
-        self._n_dispatch = 0
-        self._stopped = False
-        self._draining = False
-        self._consec_errors = 0
+        # bucket warmup traced the forward: surface any replicate
+        # fallbacks NOW — a serving-only process must see its FF106s
+        # without ever running a train step (ISSUE 9)
+        model._surface_runtime_fallbacks()
+        # lifecycle state machine: every write happens under
+        # self._lifecycle (RL009); the lock-free health property reads
+        # are the one documented exception
+        self._thread: Optional[  # guarded_by: self._lifecycle
+            threading.Thread] = None
+        self._n_dispatch = 0  # dispatcher-thread-only (single writer)
+        self._stopped = False    # guarded_by: self._lifecycle
+        self._draining = False   # guarded_by: self._lifecycle
+        self._consec_errors = 0  # dispatcher-thread-only (single writer)
         self._degraded_after_errors = int(degraded_after_errors)
         self._degraded_drop_frac = float(degraded_drop_frac)
-        self._last_health = "starting"
+        self._last_health = "starting"  # guarded_by: self._health_lock
         self._health_lock = threading.Lock()
-        self._finalized = False  # final serve_stats emitted exactly once
+        # final serve_stats emitted exactly once
+        self._finalized = False  # guarded_by: self._lifecycle
         self._shutdown_done = threading.Event()
         self._serve_faults: List[Dict] = []
         self._lifecycle = threading.Lock()
@@ -221,11 +230,11 @@ class ServingEngine:
         live counters, so a recovery — successful dispatch, drop rate
         decaying out of the window — flips it back without an edge
         event having to fire first."""
-        if self._stopped:
+        if self._stopped:      # unguarded-ok: lock-free health read
             return "stopped"
-        if self._draining:
+        if self._draining:     # unguarded-ok: lock-free health read
             return "draining"
-        if self._thread is None:
+        if self._thread is None:  # unguarded-ok: lock-free health read
             return "starting"
         if self._consec_errors >= self._degraded_after_errors:
             return "degraded"
@@ -673,6 +682,9 @@ class ServingEngine:
         # result() must not swallow the degraded->serving transition
         self._consec_errors = 0
         self._health_tick()
+        # a bucket re-lowered mid-serve (model re-compile, reshard)
+        # re-traces: drain any fresh fallback records (no-op when warm)
+        model._surface_runtime_fallbacks()
         self.metrics.record_dispatch(rows, bucket, len(reqs), depth,
                                      now - t0)
         off = 0

@@ -53,32 +53,39 @@ class ServingMetrics:
         self.clock = clock
         self.queue_depth_fn = queue_depth_fn
         self._lock = threading.Lock()
+        # every rolling-window structure and counter below is
+        # guarded_by self._lock (RL009): records arrive from producer
+        # threads AND the dispatcher concurrently
         # (t, rows, bucket, n_reqs, dispatch_s) per packed batch
-        self._dispatches: deque = deque()
+        self._dispatches: deque = deque()  # guarded_by: self._lock
         # (t, latency_s) per completed logical request
-        self._latencies: deque = deque(maxlen=max_latency_samples)
+        self._latencies: deque = deque(  # guarded_by: self._lock
+            maxlen=max_latency_samples)
         # (t, latency_s) for the subset that carried a deadline — the
         # SLO-attainment population deadline_p99_ms reports on
-        self._deadline_lats: deque = deque(maxlen=max_latency_samples)
+        self._deadline_lats: deque = deque(  # guarded_by: self._lock
+            maxlen=max_latency_samples)
         # (t, n) windowed admission/drop event streams for the health
         # state machine's shed-rate threshold, with RUNNING sums so
         # drop_stats() is O(1) on the hot dispatcher path; trimmed on
         # every append (not only on reads) and hard-capped so a wedged
         # dispatcher under a submit storm cannot grow metrics memory
-        self._submit_ts: deque = deque()
-        self._drop_ts: deque = deque()
-        self._submit_n = 0
-        self._drop_n = 0
-        self._queue_depth = 0
-        self._last_dispatch_t: Optional[float] = None
-        self.total_dispatches = 0
-        self.total_requests = 0
-        self.total_rows = 0
-        self.total_errors = 0
-        self.total_rejected = 0
-        self.total_shed = 0
-        self.total_expired = 0
-        self.blocked_ms_total = 0.0
+        self._submit_ts: deque = deque()  # guarded_by: self._lock
+        self._drop_ts: deque = deque()    # guarded_by: self._lock
+        self._submit_n = 0   # guarded_by: self._lock
+        self._drop_n = 0     # guarded_by: self._lock
+        self._queue_depth = 0  # guarded_by: self._lock
+        # the dispatcher's heartbeat: last dispatch completion time,
+        # the stall gauge last_dispatch_age_s reads
+        self._last_dispatch_t: Optional[float] = None  # guarded_by: self._lock
+        self.total_dispatches = 0  # guarded_by: self._lock
+        self.total_requests = 0    # guarded_by: self._lock
+        self.total_rows = 0        # guarded_by: self._lock
+        self.total_errors = 0      # guarded_by: self._lock
+        self.total_rejected = 0    # guarded_by: self._lock
+        self.total_shed = 0        # guarded_by: self._lock
+        self.total_expired = 0     # guarded_by: self._lock
+        self.blocked_ms_total = 0.0  # guarded_by: self._lock
 
     # hard cap on windowed admission/drop EVENTS (not requests — each
     # entry may carry n>1): bounds memory even when the window itself
@@ -86,7 +93,7 @@ class ServingMetrics:
     _MAX_WINDOW_EVENTS = 65536
 
     # ---- recording -----------------------------------------------------
-    def _trim(self, now: float) -> None:
+    def _trim(self, now: float) -> None:  # guarded_by: self._lock
         horizon = now - self.window_s
         for dq in (self._dispatches, self._latencies, self._deadline_lats):
             while dq and dq[0][0] < horizon:

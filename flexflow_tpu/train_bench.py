@@ -66,7 +66,10 @@ def bench_k(k: int, steps: int = 64, batch_size: int = 32,
     ``block_until_ready``."""
     import jax
 
+    from flexflow_tpu.analysis import comm_plan_digest_for_model
+
     model = _build_model(k, batch_size, hidden, seed)
+    plan_digest = comm_plan_digest_for_model(model)
     x, y = _data(steps, batch_size, seed)
     model.warmup_compile(x[:batch_size], y[:batch_size])
     model.fit(x, y, epochs=1, verbose=False)  # warm: loader + window sizes
@@ -83,6 +86,10 @@ def bench_k(k: int, steps: int = 64, batch_size: int = 32,
         "dispatches": -(-steps // k) * epochs,
         "batch_size": batch_size,
         "final_loss": round(float(model.last_epoch_losses[-1]), 6),
+        # which sharding/communication plan this row measured (the
+        # static plan digest from flexflow-tpu explain — rows with
+        # different plans are different populations, like device_kind)
+        "comm_plan_digest": plan_digest,
     }
 
 
@@ -159,6 +166,8 @@ def main(argv=None) -> None:
         "steps_per_epoch": args.steps,
         "device_kind": kind,
         "calibration_digest": digest,
+        "comm_plan_digest": (results[0].get("comm_plan_digest")
+                             if results else None),
         "results": results,
     }
     text = json.dumps(payload, indent=2)

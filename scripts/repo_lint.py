@@ -52,6 +52,21 @@ by tier-1 ``tests/test_static_checks.py``).  Rules:
   serve loop itself — the analogue of RL004's epoch loop); any
   ``float``/``np.asarray``/``jax.device_get`` inside a ``for`` loop
   there is a per-request sync and is rejected.
+* **RL009 — lock-annotated fields are only touched under their lock**
+  (ISSUE 9): a field assignment in ``flexflow_tpu/serving/`` or
+  ``flexflow_tpu/parallel/elastic.py`` may carry a
+  ``# guarded_by: self._cv`` comment; every OTHER read/write of that
+  ``self.<field>`` in the same class must then sit lexically inside a
+  ``with self._cv:`` block (condition variables acquire their lock), or
+  in a helper whose ``def`` line carries the same ``# guarded_by:``
+  annotation (the documented caller-holds-the-lock contract), or on a
+  line annotated ``# unguarded-ok: <why>`` (the rare deliberate
+  lock-free read — e.g. the engine's lock-free ``health`` property).
+  ``__init__`` is exempt (no concurrent access before construction
+  completes); nested functions start with NO held locks (a closure may
+  run on another thread).  This is the static half of the overload
+  stack's thread-safety story: the fake-clock tests exercise the
+  schedules, RL009 pins the discipline.
 * **RL008 — serving code reads time only through the injected clock**
   (ISSUE 8): a bare ``time.time()``/``time.monotonic()`` call inside
   ``flexflow_tpu/serving/`` bypasses the ``clock=`` every serving
@@ -71,6 +86,7 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 import sys
 from typing import List, Optional, Tuple
 
@@ -128,6 +144,110 @@ _RL007_EXEMPT = ("flexflow_tpu/search/cost_model.py",
 _RL007_LO, _RL007_HI = 1e8, 1e16
 
 
+# `# guarded_by: self._cv` (field or def-line) / `# unguarded-ok: why`
+_GUARDED_RE = re.compile(r"#\s*guarded_by:\s*([\w.]+)")
+_UNGUARDED_RE = re.compile(r"#\s*unguarded-ok\b")
+
+
+class _GuardChecker(ast.NodeVisitor):
+    """RL009 — per-class lock-discipline check.  Pass 1 collects
+    ``self.<field> = ...  # guarded_by: <lock>`` annotations; pass 2
+    walks every method tracking which locks are lexically held
+    (``with <lock>:`` blocks, plus a ``# guarded_by:`` annotation on
+    the ``def`` line for caller-holds helpers) and flags annotated-field
+    accesses outside them."""
+
+    def __init__(self, lines, add):
+        self.lines = lines
+        self._add = add
+        self.fields = {}        # field name -> lock dotted name
+        self._held = frozenset()
+        self._checking = False
+
+    def _line(self, node) -> str:
+        return (self.lines[node.lineno - 1]
+                if 0 < node.lineno <= len(self.lines) else "")
+
+    def check_class(self, cls: ast.ClassDef) -> None:
+        # pass 1: collect annotated fields (any `self.X =` whose line
+        # carries the guarded_by comment)
+        for node in ast.walk(cls):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign,
+                                     ast.AugAssign)):
+                continue
+            m = _GUARDED_RE.search(self._line(node))
+            if not m:
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    self.fields[t.attr] = m.group(1)
+        if not self.fields:
+            return
+        # pass 2: check every method except __init__ (single-threaded
+        # construction — it is where the annotations live)
+        for node in cls.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name != "__init__":
+                self._check_func(node)
+
+    def _check_func(self, fn) -> None:
+        held = set()
+        m = _GUARDED_RE.search(self._line(fn))
+        if m:
+            held.add(m.group(1))  # caller-holds contract
+        prev, self._held = self._held, frozenset(held)
+        was, self._checking = self._checking, True
+        for stmt in fn.body:
+            self.visit(stmt)
+        self._held, self._checking = prev, was
+
+    def visit_With(self, node: ast.With) -> None:
+        names = set()
+        for item in node.items:
+            d = _dotted(item.context_expr)
+            if d is None and isinstance(item.context_expr, ast.Call):
+                d = _dotted(item.context_expr.func)
+            if d:
+                names.add(d)
+        for item in node.items:
+            self.visit(item.context_expr)
+        prev, self._held = self._held, self._held | names
+        for stmt in node.body:
+            self.visit(stmt)
+        self._held = prev
+
+    visit_AsyncWith = visit_With
+
+    def visit_FunctionDef(self, node) -> None:
+        # a nested `def` (callback/closure) may run on another thread:
+        # it starts with NO held locks.  Lambdas inherit the current
+        # held set — the sort-key/filter lambda evaluated synchronously
+        # under the caller's lock is the overwhelmingly common case.
+        self._check_func(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if (self._checking and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and node.attr in self.fields):
+            lock = self.fields[node.attr]
+            if lock not in self._held \
+                    and not _UNGUARDED_RE.search(self._line(node)):
+                self._add(node, "RL009",
+                          f"self.{node.attr} is annotated guarded_by "
+                          f"{lock} but accessed outside a `with {lock}` "
+                          f"block — take the lock, mark the helper's "
+                          f"def line `# guarded_by: {lock}` (caller "
+                          f"holds), or annotate the line "
+                          f"`# unguarded-ok: <why>`")
+        self.generic_visit(node)
+
+
 class _Visitor(ast.NodeVisitor):
     def __init__(self, relpath: str, lines: Optional[List[str]] = None):
         self.relpath = relpath
@@ -146,6 +266,11 @@ class _Visitor(ast.NodeVisitor):
         self.in_serving = relpath.startswith("flexflow_tpu/serving/")
         self.in_clock_scope = (self.in_serving
                                and relpath not in _RL008_EXEMPT)
+        # RL009 engages where the concurrency-heavy classes live (the
+        # ISSUE 9 scope): the serving stack and the elastic supervisor
+        self.in_guard_scope = (self.in_serving
+                               or relpath == "flexflow_tpu/parallel/"
+                                              "elastic.py")
         self.is_mesh_factory = relpath == "flexflow_tpu/parallel/mesh.py"
         self._hot_func: Optional[str] = None  # inside fit/evaluate/predict
         self._batch_loops = 0                 # nested non-epoch loop depth
@@ -155,6 +280,11 @@ class _Visitor(ast.NodeVisitor):
 
     def _add(self, node: ast.AST, code: str, msg: str) -> None:
         self.findings.append((node.lineno, code, msg))
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        if self.in_guard_scope:
+            _GuardChecker(self.lines, self._add).check_class(node)
+        self.generic_visit(node)
 
     def visit_Call(self, node: ast.Call) -> None:
         name = _dotted(node.func)

@@ -42,6 +42,12 @@ echo "== calibration artifact schema (calibrate --check) =="
 # shellcheck disable=SC2086
 python -m flexflow_tpu.cli calibrate --check $calib_files || rc=1
 
+# shipped example strategies must keep linting clean and producing
+# schema-valid `lint --json` / `explain --json` reports — a committed
+# .pb (or a report-schema change) can never rot silently
+echo "== shipped strategy artifacts (lint + explain) =="
+python scripts/check_strategy_artifacts.py || rc=1
+
 if [ "$rc" -eq 0 ]; then
     echo "static checks: OK"
 else

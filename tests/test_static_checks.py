@@ -246,6 +246,85 @@ def test_static_checks_script_passes_on_repo():
     ("flexflow_tpu/zz_ok_clock_elsewhere.py",
      "import time\n\ndef t():\n    return time.time()\n",
      None),
+    # RL009: a field annotated `# guarded_by: <lock>` read/written
+    # outside a `with <lock>` block in the serving/elastic scope
+    ("flexflow_tpu/serving/zz_bad_guard.py",
+     "import threading\n\n"
+     "class Q:\n"
+     "    def __init__(self):\n"
+     "        self._cv = threading.Condition()\n"
+     "        self._rows = 0  # guarded_by: self._cv\n"
+     "    def depth(self):\n"
+     "        return self._rows\n",
+     "RL009"),
+    # ...taking the lock is the fix
+    ("flexflow_tpu/serving/zz_ok_guard_with.py",
+     "import threading\n\n"
+     "class Q:\n"
+     "    def __init__(self):\n"
+     "        self._cv = threading.Condition()\n"
+     "        self._rows = 0  # guarded_by: self._cv\n"
+     "    def depth(self):\n"
+     "        with self._cv:\n"
+     "            return self._rows\n",
+     None),
+    # ...or the caller-holds helper contract on the def line
+    ("flexflow_tpu/serving/zz_ok_guard_helper.py",
+     "import threading\n\n"
+     "class Q:\n"
+     "    def __init__(self):\n"
+     "        self._cv = threading.Condition()\n"
+     "        self._rows = 0  # guarded_by: self._cv\n"
+     "    def _pop(self):  # guarded_by: self._cv\n"
+     "        self._rows -= 1\n"
+     "    def take(self):\n"
+     "        with self._cv:\n"
+     "            self._pop()\n",
+     None),
+    # ...or the documented deliberate lock-free read
+    ("flexflow_tpu/serving/zz_ok_guard_waiver.py",
+     "import threading\n\n"
+     "class Q:\n"
+     "    def __init__(self):\n"
+     "        self._cv = threading.Condition()\n"
+     "        self._closed = False  # guarded_by: self._cv\n"
+     "    def closed(self):\n"
+     "        return self._closed  # unguarded-ok: racy read is benign\n",
+     None),
+    # a nested def (callback — may run on another thread) does NOT
+    # inherit the enclosing with-block's lock
+    ("flexflow_tpu/serving/zz_bad_guard_closure.py",
+     "import threading\n\n"
+     "class Q:\n"
+     "    def __init__(self):\n"
+     "        self._cv = threading.Condition()\n"
+     "        self._rows = 0  # guarded_by: self._cv\n"
+     "    def make_cb(self):\n"
+     "        with self._cv:\n"
+     "            def cb():\n"
+     "                return self._rows\n"
+     "        return cb\n",
+     "RL009"),
+    # elastic.py is in scope too
+    ("flexflow_tpu/parallel/elastic.py",
+     "import threading\n\n"
+     "class S:\n"
+     "    def __init__(self):\n"
+     "        self._lock = threading.Lock()\n"
+     "        self._hb = {}  # guarded_by: self._lock\n"
+     "    def read(self):\n"
+     "        return dict(self._hb)\n",
+     "RL009"),
+    # outside the serving/elastic scope the rule does not engage
+    ("flexflow_tpu/zz_ok_guard_elsewhere.py",
+     "import threading\n\n"
+     "class Q:\n"
+     "    def __init__(self):\n"
+     "        self._cv = threading.Condition()\n"
+     "        self._rows = 0  # guarded_by: self._cv\n"
+     "    def depth(self):\n"
+     "        return self._rows\n",
+     None),
     # RL007: hardware-rate literals (bytes/s, FLOP/s band) in op/search
     # code are fossilized calibration numbers — they belong in
     # cost_model.DeviceSpec or the CalibrationTable (ISSUE 7)
