@@ -31,8 +31,9 @@ from .model import FFModel
 from .op import Op, OpType
 from .optimizers import AdamOptimizer, Optimizer, SGDOptimizer
 from .parallel.mesh import MachineMesh
-from .serving import (DeadlineExceeded, OverloadError, ServingEngine,
-                      ServingError, SheddedError)
+from .serving import (DeadlineExceeded, GenerationCancelled,
+                      GenerationEngine, GenerationStream, OverloadError,
+                      ServingEngine, ServingError, SheddedError)
 from .tensor import Parameter, Tensor
 
 __version__ = "0.2.0"

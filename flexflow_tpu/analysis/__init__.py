@@ -15,6 +15,7 @@ never cost a split the executor silently replicates.  Entry points:
 
 from .diagnostics import (CODES, Diagnostic, DiagnosticReport, Severity,
                           VerificationError, make, validate_report_json)
+from .kv_memory import kv_cache_bytes, kv_cache_layout
 from .legality import config_diagnostics, degree_executable, per_dim_degrees
 from .sharding_passes import (comm_plan_digest, comm_plan_digest_for_model,
                               communication_plan, explain_report,
@@ -31,5 +32,5 @@ __all__ = [
     "drain_fallback_sites", "predict_fallbacks", "propagate_specs",
     "communication_plan", "comm_plan_digest", "comm_plan_digest_for_model",
     "explain_report", "render_explain_text", "validate_explain_json",
-    "validate_report_json",
+    "validate_report_json", "kv_cache_bytes", "kv_cache_layout",
 ]

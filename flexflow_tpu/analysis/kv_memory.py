@@ -1,0 +1,151 @@
+"""KV-cache memory accounting — ONE layout/byte source shared by the
+runtime and the static tools (ISSUE 11 satellite).
+
+The token-generation engine preallocates per-slot decode state:
+attention ops hold a K and a V cache of ``(slots, max_seq, heads,
+head_dim)`` each (heads sharded over the tensor-parallel ``c`` mesh
+axis, slots over the data axis ``n``), LSTM ops carry an f32 ``(h, c)``
+state pair of ``(slots, hidden)``.  That HBM is resident for the life
+of the engine — exactly the kind of allocation a static HBM gate must
+know about, so :func:`kv_cache_bytes` is consumed by
+
+* the :class:`~flexflow_tpu.serving.generation.GenerationEngine`
+  (which also derives its actual cache placement from
+  :func:`kv_cache_layout` — the runtime allocates what this module
+  predicts, byte for byte);
+* ``flexflow-tpu lint --serve-slots N --serve-seq S`` — the FF108 HBM
+  gate and the FF121 liveness timeline both add the same scalar, so
+  lint and the engine cannot disagree about whether a generation
+  deployment fits;
+* ``flexflow-tpu explain`` — the memory report grows a ``kv_cache``
+  section with the same numbers.
+
+Device-free: meshes are plain ``{axis: size}`` dicts (the
+:class:`~flexflow_tpu.parallel.mesh.AbstractMesh` view), so a 64-chip
+serving deployment is sized from a laptop.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..op import Op, OpType
+
+# the LSTM decode carry stays f32 across timesteps (ops/rnn.py keeps
+# cell state in f32 for stability) regardless of the compute dtype
+STATE_DTYPE_BYTES = 4
+
+
+def _axis(mesh_sizes: Optional[Dict[str, int]], axis: str) -> int:
+    return max(1, int((mesh_sizes or {}).get(axis, 1)))
+
+
+def slot_shard_degree(slots: int, mesh_sizes: Optional[Dict[str, int]]
+                      ) -> int:
+    """How many ways the slot (decode-batch) dim shards over the data
+    axis ``n`` — mirrors ``FFModel._infer_batch_entries``'s rule: never
+    below 2 slots per shard (a 1-row shard lowers to matrix-vector
+    kernels and breaks the decode==forward parity contract), replicate
+    when the axis does not divide."""
+    n = _axis(mesh_sizes, "n")
+    if n > 1 and slots % n == 0 and slots >= 2 * n:
+        return n
+    return 1
+
+
+def kv_cache_layout(layers: List[Op],
+                    mesh_sizes: Optional[Dict[str, int]],
+                    slots: int, max_seq: int) -> Dict[str, Dict]:
+    """Per-op decode-cache geometry: ``{op_name: {"kind": "kv"|"state",
+    "shapes": {leaf: shape}, "entries": {leaf: PartitionSpec entries},
+    "dtype": "compute"|"f32"}}``.  THE one place the cache layout is
+    decided — the generation decoder allocates exactly this, and
+    :func:`kv_cache_bytes` integrates exactly this."""
+    n_deg = slot_shard_degree(slots, mesh_sizes)
+    c = _axis(mesh_sizes, "c")
+    out: Dict[str, Dict] = {}
+    for op in layers:
+        if op.op_type == OpType.ATTENTION and hasattr(op, "num_heads"):
+            h, hd = op.num_heads, op.head_dim
+            c_entry = "c" if (c > 1 and h % c == 0) else None
+            n_entry = "n" if n_deg > 1 else None
+            shape = (int(slots), int(max_seq), h, hd)
+            entries = (n_entry, None, c_entry, None)
+            out[op.name] = {
+                "kind": "kv",
+                "shapes": {"k": shape, "v": shape},
+                "entries": {"k": entries, "v": entries},
+                "dtype": "compute",
+            }
+        elif op.op_type == OpType.LSTM and hasattr(op, "hidden_size"):
+            hsz = op.hidden_size
+            c_entry = "c" if (c > 1 and hsz % c == 0) else None
+            n_entry = "n" if n_deg > 1 else None
+            shape = (int(slots), hsz)
+            entries = (n_entry, c_entry)
+            out[op.name] = {
+                "kind": "state",
+                "shapes": {"h": shape, "c": shape},
+                "entries": {"h": entries, "c": entries},
+                "dtype": "f32",
+            }
+    return out
+
+
+def kv_cache_bytes(layers: List[Op],
+                   mesh_sizes: Optional[Dict[str, int]],
+                   slots: int, max_seq: int,
+                   kv_dtype_bytes: int = 2) -> float:
+    """Per-DEVICE bytes of the preallocated decode state for ``slots``
+    concurrent streams of up to ``max_seq`` positions: attention K+V
+    (``kv_dtype_bytes`` — the compute dtype the caches are held in,
+    2 for bf16, 4 for f32) sharded ``slots/n x heads/c``, plus the f32
+    LSTM (h, c) carries.  Integrates :func:`kv_cache_layout` — the
+    engine's real allocation and this number cannot drift apart."""
+    layout = kv_cache_layout(layers, mesh_sizes, slots, max_seq)
+    n_deg = slot_shard_degree(slots, mesh_sizes)
+    c = _axis(mesh_sizes, "c")
+    total = 0.0
+    for entry in layout.values():
+        bytes_per = (kv_dtype_bytes if entry["dtype"] == "compute"
+                     else STATE_DTYPE_BYTES)
+        for leaf, shape in entry["shapes"].items():
+            vol = 1
+            for s in shape:
+                vol *= int(s)
+            parts = 1
+            for e in entry["entries"][leaf]:
+                if e == "n":
+                    parts *= n_deg
+                elif e == "c":
+                    parts *= c
+            total += vol * bytes_per / parts
+    return total
+
+
+def default_serve_seq(input_tensors) -> Optional[int]:
+    """The ``--serve-seq`` default: the model's sequence length when it
+    has a sequence-shaped input, else None (the caller must require an
+    explicit flag).  ONE implementation shared by ``lint`` and
+    ``explain`` so the two subcommands can never default the same
+    model to different KV sizes."""
+    tins = list(input_tensors or [])
+    if tins and len(tins[0].shape) > 1:
+        return int(tins[0].shape[1])
+    return None
+
+
+def dtype_bytes(dtype_name: str) -> int:
+    """Byte width of a compute dtype name ('bfloat16' -> 2,
+    'float32' -> 4) — shared by the engine and the CLI so both feed
+    :func:`kv_cache_bytes` the same ``kv_dtype_bytes``."""
+    import numpy as np
+    try:
+        return int(np.dtype(dtype_name).itemsize)
+    except TypeError:
+        # np has no bfloat16; it is 2 bytes
+        return 2 if "bfloat16" in str(dtype_name) else 4
+
+
+__all__ = ["kv_cache_layout", "kv_cache_bytes", "slot_shard_degree",
+           "dtype_bytes", "default_serve_seq", "STATE_DTYPE_BYTES"]

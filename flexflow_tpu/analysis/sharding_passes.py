@@ -299,12 +299,18 @@ def explain_report(model_name: str, layers: List[Op],
                    num_devices: Optional[int] = None,
                    dtype_bytes: int = 2, spec=None,
                    opt_slot_bytes: int = 4,
-                   sparse_tables=frozenset()) -> Dict:
+                   sparse_tables=frozenset(),
+                   serve_slots: int = 0,
+                   serve_seq: int = 0) -> Dict:
     """The full device-free ``flexflow-tpu explain`` payload: propagated
     sharding summary, predicted FF120 fallbacks, the communication plan
     (+ digest), and the liveness HBM timeline.  ``mesh_shape`` defaults
     to the same static inference lint runs
-    (``strategy_passes.infer_mesh_shape``)."""
+    (``strategy_passes.infer_mesh_shape``).  ``serve_slots``/
+    ``serve_seq`` > 0 size a token-generation deployment: the KV cache
+    (analysis.kv_memory — the engine's own accounting) rides in the
+    memory timeline's resident state and a ``kv_cache`` section is
+    added."""
     from ..search.cost_model import spec_for_device
     from ..search.simulator import Simulator
     from .strategy_passes import infer_mesh_shape
@@ -339,11 +345,22 @@ def explain_report(model_name: str, layers: List[Op],
                     use_native=False, dtype_bytes=dtype_bytes,
                     opt_slot_bytes=opt_slot_bytes,
                     sparse_tables=sparse_tables)
+    kv_bytes = 0.0
+    kv_section = None
+    if serve_slots > 0 and serve_seq > 0:
+        from .kv_memory import kv_cache_bytes
+        kv_bytes = kv_cache_bytes(layers, mesh_shape, serve_slots,
+                                  serve_seq, kv_dtype_bytes=dtype_bytes)
+        kv_section = {"slots": int(serve_slots),
+                      "max_seq": int(serve_seq),
+                      "bytes_per_device": kv_bytes}
     timeline = sim.memory_timeline(layers, strategies, mesh_shape,
-                                   assume_remat=False)
+                                   assume_remat=False,
+                                   extra_state_bytes=kv_bytes)
     sharded = sum(1 for entries in specs.values()
                   if any(e not in (None, ()) for e in entries))
     return {
+        **({"kv_cache": kv_section} if kv_section else {}),
         "report": "explain",
         "model": model_name,
         "mesh": dict(mesh.sizes),
@@ -412,6 +429,13 @@ def render_explain_text(rep: Dict, top: int = 8) -> str:
             f"x{w['replicas']} replicas"
             + (" (sparse rows)" if w.get("sparse_rows_only") else ""))
     m = rep["memory_timeline"]
+    kv = rep.get("kv_cache")
+    if kv:
+        lines.append(
+            f"  KV cache: {kv['slots']} decode slot(s) x "
+            f"{kv['max_seq']} positions = "
+            f"{kv['bytes_per_device'] / 1e6:.2f} MB/device "
+            f"(resident in the timeline below)")
     lines.append(
         f"  HBM timeline: state {m['state_bytes'] / 1e9:.3f} GB, "
         f"high-water {m['peak_bytes'] / 1e9:.3f} GB at "

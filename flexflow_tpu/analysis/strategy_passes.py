@@ -65,7 +65,8 @@ def memory_diagnostics(layers: List[Op],
                        mesh_shape: MeshShape, num_devices: int,
                        spec=None, opt_slot_bytes: int = 4,
                        sparse_tables=frozenset(),
-                       xla_temp_factor: Optional[float] = None
+                       xla_temp_factor: Optional[float] = None,
+                       extra_state_bytes: float = 0.0
                        ) -> List[Diagnostic]:
     """FF108 — per-device peak memory vs the HBM budget, through the SAME
     accounting the search's legality check uses (Simulator.peak_memory_bytes
@@ -73,7 +74,14 @@ def memory_diagnostics(layers: List[Op],
     one the search would score inf, and vice versa.  ``xla_temp_factor``
     overrides the built-in compiler-temp factor with a machine-measured
     one (a CalibrationTable's ``xla_temp_factor`` via
-    ``flexflow-tpu lint --calibration``)."""
+    ``flexflow-tpu lint --calibration``).  ``extra_state_bytes``: extra
+    always-resident per-device state — the generation engine's KV cache
+    (``analysis.kv_memory.kv_cache_bytes``, ``lint --serve-slots``) —
+    added to BOTH the FF108 scalar and the FF121 timeline AFTER the
+    compiler-temp factor: the cache is a preallocated buffer with no
+    XLA temps, and scaling it would charge 2.1x what the engine
+    actually allocates (gating feasible deployments), so the HBM gate
+    and the runtime's own accounting cannot disagree."""
     from ..search.cost_model import XLA_TEMP_FACTOR, spec_for_device
     from ..search.simulator import Simulator
 
@@ -84,7 +92,8 @@ def memory_diagnostics(layers: List[Op],
                     use_native=False, opt_slot_bytes=opt_slot_bytes,
                     sparse_tables=sparse_tables)
     peak = sim.peak_memory_bytes(layers, strategies, mesh_shape,
-                                 assume_remat=False) * factor
+                                 assume_remat=False
+                                 ) * factor + extra_state_bytes
     # the liveness timeline (Simulator.memory_timeline): same
     # components, interval analysis on top — its high-water is >= the
     # scalar sum by construction, and it NAMES the peak (FF121).  The
@@ -92,8 +101,12 @@ def memory_diagnostics(layers: List[Op],
     # so lint gating and search legality cannot disagree; FF121 (WARN)
     # reports the strictly-stronger liveness bound with the offending
     # interval when IT overflows.
+    # the timeline likewise carries the KV scalar unscaled: the sims
+    # run WITHOUT it and it rides on top of the factored totals below
     tl = sim.memory_timeline(layers, strategies, mesh_shape,
                              assume_remat=False)
+    kv_note = (f", {extra_state_bytes / 1e9:.2f} GB KV cache"
+               if extra_state_bytes else "")
     diags: List[Diagnostic] = []
     if peak > spec.hbm_capacity:
         owners = ", ".join(o["op"] for o in tl["peak_owners"][:3]) \
@@ -101,25 +114,26 @@ def memory_diagnostics(layers: List[Op],
         diags.append(make(
             "FF108", "",
             f"estimated per-device peak {peak / 1e9:.2f} GB (incl. "
-            f"{factor}x compiler-temp factor) exceeds the "
+            f"{factor}x compiler-temp factor{kv_note}) exceeds the "
             f"{spec.hbm_capacity / 1e9:.1f} GB HBM budget; the search "
             f"scores this strategy infeasible (inf); largest resident "
             f"activations: {owners}",
             hint="raise the sharding degrees, shard the optimizer, or "
                  "lower the batch size"))
-    tl_peak = tl["peak_bytes"] * factor
+    tl_peak = tl["peak_bytes"] * factor + extra_state_bytes
     if tl_peak > spec.hbm_capacity:
         ev = tl["peak_event"]
         owners = ", ".join(
             f"{o['op']} ({o['act_bytes'] / 1e6:.1f} MB)"
             for o in tl["peak_owners"][:3]) or "(parameter state)"
+        state_total = tl["state_bytes"] * factor + extra_state_bytes
         diags.append(make(
             "FF121", ev["op"],
             f"liveness high-water {tl_peak / 1e9:.2f} GB (incl. "
-            f"{factor}x compiler-temp factor) exceeds the "
+            f"{factor}x compiler-temp factor{kv_note}) exceeds the "
             f"{spec.hbm_capacity / 1e9:.1f} GB HBM budget at the "
             f"{ev['phase']} of {ev['op']!r} (state "
-            f"{tl['state_bytes'] * factor / 1e9:.2f} GB resident); "
+            f"{state_total / 1e9:.2f} GB resident); "
             f"peak owners: {owners}",
             hint="re-shard or rematerialize the peak-owning ops first "
                  "(flexflow-tpu explain shows the full timeline)"))

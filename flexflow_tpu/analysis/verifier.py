@@ -33,7 +33,8 @@ def verify(layers: List[Op],
            sparse_tables=frozenset(),
            xla_temp_factor: Optional[float] = None,
            check_memory: bool = True,
-           check_resharding: bool = True) -> DiagnosticReport:
+           check_resharding: bool = True,
+           extra_state_bytes: float = 0.0) -> DiagnosticReport:
     """Static verification of a graph + strategy.
 
     ``mesh_shape`` defaults to the static inference the executor would
@@ -98,7 +99,8 @@ def verify(layers: List[Op],
         report.extend(memory_diagnostics(
             layers, strategies, mesh_shape, num_devices, spec=spec,
             opt_slot_bytes=opt_slot_bytes, sparse_tables=sparse_tables,
-            xla_temp_factor=xla_temp_factor))
+            xla_temp_factor=xla_temp_factor,
+            extra_state_bytes=extra_state_bytes))
     if check_resharding:
         report.extend(resharding_diagnostics(layers, strategies,
                                              num_devices))

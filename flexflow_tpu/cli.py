@@ -72,7 +72,8 @@ def main(argv=None) -> None:
               "<script.py> [script args]\n"
               "       flexflow-tpu search-bench [flags]\n"
               "       flexflow-tpu train-bench [flags]\n"
-              "       flexflow-tpu serve-bench [flags]\n"
+              "       flexflow-tpu serve-bench [--overload|--generate] "
+              "[flags]\n"
               "       flexflow-tpu calibrate [--out table.json | "
               "--check FILE...]\n"
               "       flexflow-tpu calibrate-bench --table table.json "
@@ -168,6 +169,14 @@ def lint_main(argv) -> int:
                         help="machine-readable report on stdout")
     parser.add_argument("--no-resharding", action="store_true",
                         help="skip the FF109 hotspot report")
+    parser.add_argument("--serve-slots", type=int, default=0,
+                        help="size a token-generation deployment: add "
+                             "the KV cache for N concurrent decode "
+                             "slots to the FF108/FF121 memory gates "
+                             "(docs/serving.md 'Token generation')")
+    parser.add_argument("--serve-seq", type=int, default=0,
+                        help="generation cache length per slot "
+                             "(default: the model's sequence length)")
     args = parser.parse_args(argv)
 
     builders = _lint_builders()
@@ -220,6 +229,28 @@ def lint_main(argv) -> int:
         spec = dataclasses.replace(spec or spec_for_device(),
                                    hbm_capacity=args.hbm_gb * 1e9)
 
+    kv_bytes = 0.0
+    if args.serve_slots > 0:
+        # the generation engine's preallocated KV cache — the SAME
+        # scalar the runtime reports (analysis.kv_memory), so the FF108
+        # gate and the engine cannot disagree about deployment fit
+        from .analysis.kv_memory import (default_serve_seq, dtype_bytes,
+                                         kv_cache_bytes)
+        seq = args.serve_seq or default_serve_seq(model.input_tensors)
+        if not seq or seq <= 0:
+            print("lint: --serve-slots needs --serve-seq (the model "
+                  "has no sequence-shaped input to default from)",
+                  file=sys.stderr)
+            return 2
+        shape_for_kv = mesh_shape
+        if shape_for_kv is None:
+            from .analysis.strategy_passes import infer_mesh_shape
+            shape_for_kv, _ = infer_mesh_shape(
+                strategies or {}, model.layers, args.devices or 10 ** 9)
+        kv_bytes = kv_cache_bytes(
+            model.layers, shape_for_kv, args.serve_slots, seq,
+            kv_dtype_bytes=dtype_bytes(cfg.compute_dtype))
+
     from .analysis import verify
     report = verify(
         model.layers, strategies, mesh_shape=mesh_shape,
@@ -228,7 +259,8 @@ def lint_main(argv) -> int:
         final_tensors=model.layers[-1].outputs if model.layers else (),
         parameters=model.parameters, spec=spec,
         xla_temp_factor=temp_factor,
-        check_resharding=not args.no_resharding)
+        check_resharding=not args.no_resharding,
+        extra_state_bytes=kv_bytes)
     print(report.render_json() if args.json else report.render_text())
     return 1 if report.errors else 0
 
@@ -268,6 +300,13 @@ def explain_main(argv) -> int:
                         help="machine-readable report on stdout")
     parser.add_argument("--out", default="",
                         help="also write the JSON report here")
+    parser.add_argument("--serve-slots", type=int, default=0,
+                        help="size a token-generation deployment: "
+                             "report the KV cache for N decode slots "
+                             "inside the memory timeline")
+    parser.add_argument("--serve-seq", type=int, default=0,
+                        help="generation cache length per slot "
+                             "(default: the model's sequence length)")
     args = parser.parse_args(argv)
 
     builders = _lint_builders()
@@ -309,10 +348,21 @@ def explain_main(argv) -> int:
         spec = dataclasses.replace(spec_for_device(),
                                    hbm_capacity=args.hbm_gb * 1e9)
 
+    serve_seq = args.serve_seq
+    if args.serve_slots > 0 and serve_seq <= 0:
+        from .analysis.kv_memory import default_serve_seq
+        serve_seq = default_serve_seq(model.input_tensors) or 0
+        if serve_seq <= 0:
+            print("explain: --serve-slots needs --serve-seq (the model "
+                  "has no sequence-shaped input to default from)",
+                  file=sys.stderr)
+            return 2
+
     from .analysis import explain_report, render_explain_text
     rep = explain_report(
         args.model, model.layers, strategies, mesh_shape=mesh_shape,
-        num_devices=args.devices or None, spec=spec)
+        num_devices=args.devices or None, spec=spec,
+        serve_slots=args.serve_slots, serve_seq=serve_seq)
     if args.json:
         import json as _json
         text = _json.dumps(rep, indent=2)

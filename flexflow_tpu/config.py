@@ -243,6 +243,21 @@ class FFConfig:
     # is lowered + AOT-compiled once at engine startup
     # (FFModel.forward_compiled) and reused for every packed batch.
     serve_buckets: str = ""
+    # Token-generation serving (flexflow_tpu/serving/generation,
+    # docs/serving.md "Token generation").  serve_gen_slots: width of
+    # the continuous-batching decode batch — the number of concurrent
+    # streams sharing one KV cache and one decode dispatch per step
+    # (>= 2: a 1-slot decode lowers matrix-vector kernels and breaks
+    # the decode==forward parity pin, like serve_buckets' floor).
+    serve_gen_slots: int = 8
+    # serve_gen_max_seq: per-slot KV-cache length (prompt + generated
+    # tokens); 0 = the model's input sequence length.  Drives the
+    # preallocated HBM the FF108/FF121 gates account with
+    # `lint --serve-slots` (analysis/kv_memory.py).
+    serve_gen_max_seq: int = 0
+    # serve_gen_max_new_tokens: default generation budget per request
+    # when submit() does not specify one.
+    serve_gen_max_new_tokens: int = 32
     # Sparse embedding-table updates (reference parity: the embedding
     # backward scatter-accumulates only the touched rows,
     # embedding.cu:192-228 — it never streams the full table).  A dense
@@ -345,6 +360,12 @@ class FFConfig:
                 cfg.serve_admission = val().lower()
             elif a == "--serve-starvation-ms":
                 cfg.serve_starvation_ms = float(val())
+            elif a == "--serve-gen-slots":
+                cfg.serve_gen_slots = int(val())
+            elif a == "--serve-gen-max-seq":
+                cfg.serve_gen_max_seq = int(val())
+            elif a == "--serve-gen-max-new":
+                cfg.serve_gen_max_new_tokens = int(val())
             # unknown flags pass through (reference forwards Legion flags)
             i += 1
         return cfg

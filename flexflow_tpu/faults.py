@@ -52,13 +52,25 @@ Grammar (specs joined by ``;``, qualifiers by ``,``)::
                             bounded-queue/shedding behavior under a
                             burst is the thing being tested
 
+    token-generation kinds (consumed by GenerationEngine's decode loop
+    — :func:`generation_faults`; docs/serving.md "Token generation"):
+
+    serve_cancel_at_token:N the FIRST stream to reach N generated
+                            tokens is cancelled mid-generation — its
+                            KV slot must free and ONLY its own stream
+                            fail (fires once)
+    serve_slow_decode:N     the first N decode steps each stall ``ms``
+                            milliseconds (default 50) through the
+                            engine's injectable sleep
+
     qualifiers: rank=R (fire only on rank R), attempt=A or attempt=*
                 (default attempt=0 — faults must not re-fire on the
                 restarted attempt or recovery could never be observed),
                 delay=SECONDS (slow_rank), exit=CODE (kill_at_step),
                 devices=D (grow_at_step/shrink_at_step target),
-                ms=MILLIS (serve_slow_dispatch), every=K
-                (serve_fail_dispatch), rows=R (serve_queue_spike)
+                ms=MILLIS (serve_slow_dispatch, serve_slow_decode),
+                every=K (serve_fail_dispatch), rows=R
+                (serve_queue_spike)
 
 Examples::
 
@@ -92,10 +104,16 @@ KILL_EXIT_CODE = 17
 KINDS = ("kill_at_step", "hang_at_step", "corrupt_ckpt",
          "spawn_fail_attempt", "slow_rank", "grow_at_step",
          "shrink_at_step", "serve_slow_dispatch", "serve_fail_dispatch",
-         "serve_queue_spike")
+         "serve_queue_spike", "serve_cancel_at_token",
+         "serve_slow_decode")
 
 SERVE_KINDS = ("serve_slow_dispatch", "serve_fail_dispatch",
                "serve_queue_spike")
+
+# token-generation kinds (GenerationEngine's decode loop —
+# docs/serving.md "Token generation"); disjoint from SERVE_KINDS so a
+# plan mixing both drives each engine's own fire points only
+GENERATION_KINDS = ("serve_cancel_at_token", "serve_slow_decode")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -331,6 +349,18 @@ def reshard_at_window(start: int, end: int):
                              end, f"devices={devices if devices else 'auto'}"))
             out.append((spec.kind, int(devices) if devices else None))
     return out
+
+
+def generation_faults() -> List[FaultSpec]:
+    """The FF_FAULT token-generation specs matching this rank/attempt,
+    in plan order (empty without a plan).  The consumer is the
+    ``GenerationEngine``, which materializes per-engine firing state at
+    ``start()`` and consults it at decode-step boundaries; this module
+    stays jax- and engine-free."""
+    p = plan()
+    if not p:
+        return []
+    return [s for s in p if s.kind in GENERATION_KINDS and _matches(s)]
 
 
 def serve_faults() -> List[FaultSpec]:

@@ -50,3 +50,23 @@ def build_nmt(config: FFConfig, vocab_size: int = 20000,
     logits = ff.dense(t, vocab_size, name="vocab_projection")
     ff.softmax(logits)
     return ff, (src, tgt), logits
+
+
+def build_lstm_lm(config: FFConfig, vocab_size: int = 64,
+                  embed_dim: int = 32, hidden_dim: int = 32,
+                  num_layers: int = 1, seq_len: int = 32
+                  ) -> Tuple[FFModel, Tensor, Tensor]:
+    """Recurrent language model — the RNN-cell workload of the
+    token-generation engine (docs/serving.md "Token generation"):
+    embedding → stacked LSTM → per-token vocab softmax.  The decode
+    path carries each layer's (h, c) state instead of a KV cache."""
+    ff = FFModel(config)
+    tokens = ff.create_tensor((config.batch_size, seq_len), dtype="int32",
+                              name="tokens")
+    t = ff.embedding(tokens, vocab_size, embed_dim, aggr="none",
+                     name="tok_embedding")
+    for i in range(num_layers):
+        t, _, _ = ff.lstm(t, hidden_dim, name=f"lm_lstm_{i}")
+    logits = ff.dense(t, vocab_size, name="vocab_projection")
+    ff.softmax(logits)
+    return ff, tokens, logits

@@ -45,3 +45,35 @@ def build_transformer(config: FFConfig, num_layers: int = 4,
     logits = ff.dense(cls, num_classes, name="classifier")
     ff.softmax(logits)
     return ff, tokens, logits
+
+
+def build_transformer_lm(config: FFConfig, num_layers: int = 2,
+                         d_model: int = 64, num_heads: int = 4,
+                         d_ff: int = 128, seq_len: int = 64,
+                         vocab_size: int = 128, dropout: float = 0.0
+                         ) -> Tuple[FFModel, Tensor, Tensor]:
+    """Causal decoder-only language model — the autoregressive workload
+    the token-generation engine serves (docs/serving.md "Token
+    generation"): token + position embeddings, causal post-norm blocks,
+    per-token LM head with softmax over the vocab.  Labels are the
+    (n, seq_len) next-token ids; the final (n, s, vocab) output is what
+    the KV-cached decode path reproduces one position at a time."""
+    ff = FFModel(config)
+    tokens = ff.create_tensor((config.batch_size, seq_len), dtype="int32",
+                              name="tokens")
+    t = ff.embedding(tokens, vocab_size, d_model, aggr="none",
+                     name="tok_embedding")
+    t = ff.position_embedding(t, max_len=seq_len)
+    for i in range(num_layers):
+        attn = ff.multihead_attention(t, num_heads=num_heads,
+                                      dropout=dropout, causal=True,
+                                      name=f"attention_{i}")
+        t = ff.layer_norm(ff.add(t, attn), name=f"ln_attn_{i}")
+        h = ff.dense(t, d_ff, activation="gelu", name=f"ffn_up_{i}")
+        if dropout > 0.0:
+            h = ff.dropout(h, dropout)
+        h = ff.dense(h, d_model, name=f"ffn_down_{i}")
+        t = ff.layer_norm(ff.add(t, h), name=f"ln_ffn_{i}")
+    logits = ff.dense(t, vocab_size, name="lm_head")
+    ff.softmax(logits)
+    return ff, tokens, logits

@@ -93,6 +93,38 @@ def _flash_attention(q, k, v, causal: bool, scale: float):
     return jnp.transpose(out, (0, 2, 1, 3))
 
 
+def _decode_attention(q, k_cache, v_cache, pos, scale: float):
+    """Single-position attention against a preallocated per-slot KV
+    cache (the autoregressive decode kernel — docs/serving.md "Token
+    generation").  ``q``: (n, 1, h, d) — each slot's current-token
+    query; ``k_cache``/``v_cache``: (n, max_seq, h, d); ``pos``: (n,)
+    int32 position of the current token (whose K/V the caller already
+    wrote).  Mirrors :func:`_dense_attention`'s arithmetic exactly —
+    f32 scores, the same finite ``NEG_INF`` mask whose exp underflows
+    to an exact 0.0 — so a decode step is bit-identical on CPU to the
+    full-sequence forward's row at ``pos`` (tests/test_generation.py
+    pins it at every prefix length).
+
+    The single query is duplicated to TWO rows and row 0 kept: a
+    ``(1, S) @ (S, d)`` probs x values product lowers to a
+    matrix-VECTOR kernel whose accumulation order drifts ~1 ulp from
+    the matrix-matrix path the full forward takes (measured on CPU;
+    the same reason serving's shape buckets start at 2 — see
+    serving/batcher.derive_buckets), while q >= 2 rows hit the
+    identical gemm micro-kernel.  One duplicated query row is noise in
+    a decode step."""
+    q2 = jnp.concatenate([q, q], axis=1)                      # (n,2,h,d)
+    scores = jnp.einsum("nqhd,nkhd->nhqk", q2, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    kpos = jnp.arange(k_cache.shape[1])
+    scores = jnp.where(kpos[None, None, None, :]
+                       > pos[:, None, None, None], NEG_INF, scores)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("nhqk,nkhd->nqhd", probs.astype(v_cache.dtype),
+                     v_cache, preferred_element_type=jnp.float32)
+    return out[:, :1]
+
+
 def _dense_attention(q, k, v, causal: bool, scale: float,
                      dropout_rate: float, rng):
     """(n,sq,h,d),(n,sk,h,d),(n,sk,h,d) -> (n,sq,h,d); f32 softmax."""
@@ -246,11 +278,13 @@ class MultiHeadAttention(Op):
         return (s_deg == mesh.axis_size("s")
                 and self.inputs[0].shape[1] % s_deg == 0)
 
-    def forward(self, params, inputs, ctx: OpContext):
-        xq = cast_compute(inputs[0], ctx)
-        xk = xq if self._self_attn else cast_compute(inputs[1], ctx)
-        xv = xq if self._self_attn else cast_compute(inputs[2], ctx)
-        n, sq, _ = xq.shape
+    def _qkv(self, params, xq, xk, xv, ctx):
+        """The q/k/v projections — ONE implementation shared by
+        forward, the prefill path (:meth:`forward_kv`) and the
+        single-token decode (:meth:`decode`), so the cached K/V a
+        decode step attends over carry exactly the bits the
+        full-sequence forward would recompute."""
+        n = xq.shape[0]
         h, hd = self.num_heads, self.head_dim
 
         def proj(x, w):
@@ -258,10 +292,26 @@ class MultiHeadAttention(Op):
                            preferred_element_type=jnp.float32)
             return cast_compute(y, ctx).reshape(n, x.shape[1], h, hd)
 
-        q = proj(xq, self.w_q)
-        k = proj(xk, self.w_k)
-        v = proj(xv, self.w_v)
-        scale = 1.0 / math.sqrt(hd)
+        return proj(xq, self.w_q), proj(xk, self.w_k), proj(xv, self.w_v)
+
+    def _out_proj(self, params, attn, n, sq, ctx):
+        """The context -> embed output projection (+bias), shared by
+        forward/prefill/decode like :meth:`_qkv`."""
+        attn = cast_compute(attn, ctx).reshape(n, sq, self.embed_dim)
+        out = jnp.einsum("nsi,oi->nso", attn,
+                         cast_compute(params[self.w_o.name], ctx),
+                         preferred_element_type=jnp.float32)
+        if self.use_bias:
+            out = out + params[self.w_bias.name].astype(out.dtype)
+        return cast_compute(out, ctx)
+
+    def forward(self, params, inputs, ctx: OpContext):
+        xq = cast_compute(inputs[0], ctx)
+        xk = xq if self._self_attn else cast_compute(inputs[1], ctx)
+        xv = xq if self._self_attn else cast_compute(inputs[2], ctx)
+        n, sq, _ = xq.shape
+        q, k, v = self._qkv(params, xq, xk, xv, ctx)
+        scale = 1.0 / math.sqrt(self.head_dim)
         rng = None
         if ctx.training and self.dropout > 0.0 and ctx.rng is not None:
             rng = jax.random.fold_in(ctx.rng, self.outputs[0].uid)
@@ -275,13 +325,57 @@ class MultiHeadAttention(Op):
             attn = _dense_attention(q, k, v, self.causal, scale,
                                     self.dropout if ctx.training else 0.0,
                                     rng)
-        attn = cast_compute(attn, ctx).reshape(n, sq, self.embed_dim)
-        out = jnp.einsum("nsi,oi->nso", attn,
-                         cast_compute(params[self.w_o.name], ctx),
-                         preferred_element_type=jnp.float32)
-        if self.use_bias:
-            out = out + params[self.w_bias.name].astype(out.dtype)
-        return [cast_compute(out, ctx)]
+        return [self._out_proj(params, attn, n, sq, ctx)]
+
+    # ---- autoregressive decode (docs/serving.md "Token generation") ----
+    def kv_cache_shape(self, slots: int, max_seq: int):
+        """Per-slot KV-cache geometry: k and v each
+        ``(slots, max_seq, num_heads, head_dim)`` — the head dim is the
+        tensor-parallel one (sharded over the ``c`` mesh axis, matching
+        the head-sharded projections that produce it)."""
+        return (int(slots), int(max_seq), self.num_heads, self.head_dim)
+
+    def forward_kv(self, params, inputs, ctx: OpContext):
+        """The prefill half of the decode path: the exact forward
+        computation, returning the per-position K/V ``(n, s, h, hd)``
+        alongside the output so the caller can seed a decode cache.
+        Self-attention + causal only (the autoregressive contract);
+        never the ring path — prefill runs on the serving mesh where
+        the sequence axis is unsplit."""
+        assert self._self_attn and self.causal, \
+            f"{self.name}: decode/prefill needs causal self-attention"
+        xq = cast_compute(inputs[0], ctx)
+        n, sq, _ = xq.shape
+        q, k, v = self._qkv(params, xq, xq, xq, ctx)
+        scale = 1.0 / math.sqrt(self.head_dim)
+        if _use_flash(q, k, ctx.flash_attention, False, training=False):
+            attn = _flash_attention(q, k, v, self.causal, scale)
+        else:
+            attn = _dense_attention(q, k, v, self.causal, scale, 0.0, None)
+        return [self._out_proj(params, attn, n, sq, ctx)], k, v
+
+    def decode(self, params, x, k_cache, v_cache, pos, ctx: OpContext):
+        """One decode step: project the current token, write its K/V
+        into the per-slot cache at ``pos``, attend over the cache.
+
+        ``x``: (slots, 1, d) hidden states; ``k_cache``/``v_cache``:
+        (slots, max_seq, h, hd); ``pos``: (slots,) int32 position of
+        the current token.  Returns ``([out], k_cache, v_cache)`` with
+        the updated caches — functional, so the jitted decode step can
+        donate the cache buffers and update them in place."""
+        n = x.shape[0]
+        xq = cast_compute(x, ctx)
+        q, k, v = self._qkv(params, xq, xq, xq, ctx)
+
+        def write(cache, upd, p):
+            return jax.lax.dynamic_update_slice(cache, upd, (p, 0, 0))
+
+        k_cache = jax.vmap(write)(k_cache, k, pos)
+        v_cache = jax.vmap(write)(v_cache, v, pos)
+        attn = _decode_attention(q, k_cache, v_cache, pos,
+                                 1.0 / math.sqrt(self.head_dim))
+        return ([self._out_proj(params, attn, n, 1, ctx)],
+                k_cache, v_cache)
 
     def parallel_dims(self):
         # (n, s, c): sample DP, sequence SP (ring), channel TP (heads)
@@ -358,6 +452,14 @@ class PositionEmbedding(Op):
         x = inputs[0]
         table = params[self.w_table.name][: x.shape[1]]
         return [x + cast_compute(table, ctx)[None]]
+
+    def decode(self, params, x, pos, ctx: OpContext):
+        """Single-position lookup for the decode path: ``x`` (slots, 1,
+        d) plus the table row at each slot's current position ``pos``
+        (slots,) — elementwise identical to forward's broadcast add at
+        that position."""
+        rows = jnp.take(params[self.w_table.name], pos, axis=0)
+        return [x + cast_compute(rows, ctx)[:, None, :]]
 
     def parallel_dims(self):
         return (True, True, False)

@@ -59,10 +59,11 @@ class LSTM(Op):
         self.w_h = self._add_weight((4 * h, h), init, "wh", sharded_dim=0)
         self.w_b = self._add_weight((4 * h,), ZeroInitializer(), "bias")
 
-    def forward(self, params, inputs, ctx: OpContext):
-        x = cast_compute(inputs[0], ctx)                      # (n,s,d)
-        n, s, _ = x.shape
-        h_sz = self.hidden_size
+    def _weights(self, params, ctx):
+        """The (wx, wh_t, bias) triple in the dtypes every execution
+        path shares — forward, the prefill (:meth:`forward_states`) and
+        the one-timestep decode (:meth:`decode`) must run the SAME gate
+        arithmetic or the decode parity contract breaks."""
         wx = cast_compute(params[self.w_x.name], ctx)
         # recurrent weights in the compute dtype: the per-step h @ Wh matmul
         # must ride the MXU at bf16 rate (f32 here costs ~3x on v5e); f32
@@ -70,26 +71,39 @@ class LSTM(Op):
         # state stays f32 for numerical stability across timesteps
         wh_t = cast_compute(params[self.w_h.name], ctx).T
         b = params[self.w_b.name].astype(jnp.float32)
-        compute_dt = wh_t.dtype
+        return wx, wh_t, b
+
+    def _cell(self, xg_t, h, c, wh_t, b):
+        """One LSTM cell update from the pre-projected input gates
+        ``xg_t`` (n, 4H) and f32 carry (h, c) — THE gate math, shared
+        verbatim by the scan body and the decode step."""
+        gates = xg_t + jnp.matmul(
+            h.astype(wh_t.dtype), wh_t,
+            preferred_element_type=jnp.float32) + b           # (n,4H)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = (jax.nn.sigmoid(f + self.forget_bias) * c
+             + jax.nn.sigmoid(i) * jnp.tanh(g))
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return h, c
+
+    def _initial_carry(self, inputs, n):
+        if self._has_state:
+            return (inputs[1].astype(jnp.float32),
+                    inputs[2].astype(jnp.float32))
+        return (jnp.zeros((n, self.hidden_size), jnp.float32),
+                jnp.zeros((n, self.hidden_size), jnp.float32))
+
+    def forward(self, params, inputs, ctx: OpContext):
+        x = cast_compute(inputs[0], ctx)                      # (n,s,d)
+        n = x.shape[0]
+        wx, wh_t, b = self._weights(params, ctx)
         # hoisted input projection: one big MXU matmul over all timesteps
         xg = jnp.einsum("nsd,gd->nsg", x, wx,
                         preferred_element_type=jnp.float32)   # (n,s,4H)
-        if self._has_state:
-            h0 = inputs[1].astype(jnp.float32)
-            c0 = inputs[2].astype(jnp.float32)
-        else:
-            h0 = jnp.zeros((n, h_sz), jnp.float32)
-            c0 = jnp.zeros((n, h_sz), jnp.float32)
+        h0, c0 = self._initial_carry(inputs, n)
 
         def step(carry, xg_t):
-            h, c = carry
-            gates = xg_t + jnp.matmul(
-                h.astype(compute_dt), wh_t,
-                preferred_element_type=jnp.float32) + b       # (n,4H)
-            i, f, g, o = jnp.split(gates, 4, axis=-1)
-            c = (jax.nn.sigmoid(f + self.forget_bias) * c
-                 + jax.nn.sigmoid(i) * jnp.tanh(g))
-            h = jax.nn.sigmoid(o) * jnp.tanh(c)
+            h, c = self._cell(xg_t, carry[0], carry[1], wh_t, b)
             return (h, c), h
 
         # measured on v5e: unroll>1 regresses (43.6% vs 53.7% MFU at n=256)
@@ -98,6 +112,66 @@ class LSTM(Op):
                                       jnp.transpose(xg, (1, 0, 2)))
         seq = cast_compute(jnp.transpose(hs, (1, 0, 2)), ctx)
         return [seq, cast_compute(h_n, ctx), cast_compute(c_n, ctx)]
+
+    # ---- autoregressive decode (docs/serving.md "Token generation") ----
+    def forward_states(self, params, inputs, ctx: OpContext):
+        """The prefill half of the decode path: forward() that also
+        returns the PER-STEP f32 (h, c) state sequences, each
+        (n, s, H) — the caller gathers the state at each slot's prompt
+        boundary to seed :meth:`decode`.  Same :meth:`_cell` math as
+        forward, so the seeded decode continues the exact trajectory."""
+        x = cast_compute(inputs[0], ctx)
+        n = x.shape[0]
+        wx, wh_t, b = self._weights(params, ctx)
+        xg = jnp.einsum("nsd,gd->nsg", x, wx,
+                        preferred_element_type=jnp.float32)
+        h0, c0 = self._initial_carry(inputs, n)
+
+        def step(carry, xg_t):
+            h, c = self._cell(xg_t, carry[0], carry[1], wh_t, b)
+            return (h, c), (h, c)
+
+        (h_n, c_n), (hs, cs) = jax.lax.scan(step, (h0, c0),
+                                            jnp.transpose(xg, (1, 0, 2)))
+        seq = cast_compute(jnp.transpose(hs, (1, 0, 2)), ctx)
+        outs = [seq, cast_compute(h_n, ctx), cast_compute(c_n, ctx)]
+        return (outs, jnp.transpose(hs, (1, 0, 2)),
+                jnp.transpose(cs, (1, 0, 2)))
+
+    def decode(self, params, x, h, c, ctx: OpContext):
+        """One-timestep decode from the carried f32 state: ``x``
+        (slots, 1, d) current-token input, ``h``/``c`` (slots, H).
+        Returns ``([seq, h_n, c_n], h, c)`` with the new f32 carry —
+        the RNN analogue of attention's KV-cache decode (the state IS
+        the cache).
+
+        The cell runs inside a LENGTH-2 ``lax.scan`` whose second step
+        consumes zeros and is discarded.  Not decoration: XLA unrolls a
+        trip-count-1 loop and re-fuses the cell's sigmoid chain with
+        different vectorization than the full forward's while-loop body
+        (measured ~1 ulp drift on CPU — ``sigmoid(a) + sigmoid(b)`` in
+        one fusion is compilation-context-dependent), while a trip
+        count >= 2 keeps the loop and compiles the IDENTICAL body, so
+        decode matches the full-sequence forward bit-for-bit
+        (tests/test_generation.py pins it).  The wasted second cell is
+        noise next to the decode step's projections."""
+        x = cast_compute(x, ctx)
+        wx, wh_t, b = self._weights(params, ctx)
+        xg = jnp.einsum("nsd,gd->nsg", x, wx,
+                        preferred_element_type=jnp.float32)   # (n,1,4H)
+        xg2 = jnp.concatenate([jnp.transpose(xg, (1, 0, 2)),
+                               jnp.zeros_like(
+                                   jnp.transpose(xg, (1, 0, 2)))], 0)
+
+        def step(carry, xg_t):
+            h2, c2 = self._cell(xg_t, carry[0], carry[1], wh_t, b)
+            return (h2, c2), (h2, c2)
+
+        _, (hs, cs) = jax.lax.scan(step, (h, c), xg2)
+        h2, c2 = hs[0], cs[0]
+        seq = cast_compute(h2, ctx)[:, None, :]
+        return ([seq, cast_compute(h2, ctx), cast_compute(c2, ctx)],
+                h2, c2)
 
     def parallel_dims(self):
         # (n, s, c): DP over samples, TP over the hidden/gate dim; the

@@ -251,7 +251,8 @@ class Simulator:
     def peak_memory_bytes(self, layers: List[Op],
                           strategies: Dict[str, ParallelConfig],
                           mesh_shape: Optional[Dict[str, int]] = None,
-                          assume_remat: Optional[bool] = None) -> float:
+                          assume_remat: Optional[bool] = None,
+                          extra_state_bytes: float = 0.0) -> float:
         """Per-chip HBM high-water estimate for a strategy: params + grads +
         optimizer slots (sharded over TP degrees) + retained activations
         (sharded over all degrees).  ``mesh_shape`` supplies the e/p axis
@@ -259,7 +260,10 @@ class Simulator:
         ``assume_remat`` overrides ``self.remat`` — the legality check
         passes False (chip evidence: XLA's footprint does not shrink
         under remat without HBM pressure, BASELINE.md round-5).
-        The reference grounds legality in real FB memory
+        ``extra_state_bytes`` adds always-resident per-device state the
+        graph itself does not show (the generation engine's KV cache —
+        analysis.kv_memory feeds the same scalar here and to the
+        runtime).  The reference grounds legality in real FB memory
         (simulator.cu:82-88); this is the explicit TPU analogue."""
         from ..ops.linear import host_placed
         from ..parallel.mesh import dim_axis_names
@@ -273,7 +277,7 @@ class Simulator:
         if remat:
             n_mat = max(1, len(layers))
             act_scale = min(1.0, 2.0 / math.sqrt(n_mat))
-        total = 0.0
+        total = float(extra_state_bytes)
         for op in layers:
             pc = strategies.get(op.name)
             out = op.outputs[0]
@@ -297,7 +301,8 @@ class Simulator:
     def memory_timeline(self, layers: List[Op],
                         strategies: Dict[str, ParallelConfig],
                         mesh_shape: Optional[Dict[str, int]] = None,
-                        assume_remat: Optional[bool] = None) -> Dict:
+                        assume_remat: Optional[bool] = None,
+                        extra_state_bytes: float = 0.0) -> Dict:
         """Liveness-based per-device HBM timeline for one training step
         — the interval analysis behind the FF121 diagnostic and the
         ``flexflow-tpu explain`` memory report.
@@ -337,7 +342,10 @@ class Simulator:
             n_mat = max(1, len(layers))
             act_scale = min(1.0, 2.0 / math.sqrt(n_mat))
 
-        state_total = 0.0
+        # always-resident extra state (e.g. the generation engine's KV
+        # cache via analysis.kv_memory) rides in state_bytes so the
+        # timeline's high-water and FF108's scalar see the same number
+        state_total = float(extra_state_bytes)
         acts: Dict[str, float] = {}
         cotangents: Dict[str, float] = {}
         for op in layers:

@@ -7,11 +7,13 @@ serving metrics and the ``flexflow-tpu serve-bench`` harness."""
 from .batcher import (ADMISSION_POLICIES, MicroBatcher, Request, bucket_for,
                       derive_buckets, split_sizes)
 from .engine import HEALTH_STATES, ServingEngine
-from .errors import (DeadlineExceeded, OverloadError, ServingError,
-                     SheddedError)
+from .errors import (DeadlineExceeded, GenerationCancelled, OverloadError,
+                     ServingError, SheddedError)
+from .generation import GenerationEngine, GenerationStream
 from .metrics import ServingMetrics
 
 __all__ = ["ServingEngine", "MicroBatcher", "Request", "ServingMetrics",
            "ServingError", "OverloadError", "SheddedError",
-           "DeadlineExceeded", "ADMISSION_POLICIES", "HEALTH_STATES",
+           "DeadlineExceeded", "GenerationCancelled", "GenerationEngine",
+           "GenerationStream", "ADMISSION_POLICIES", "HEALTH_STATES",
            "bucket_for", "derive_buckets", "split_sizes"]

@@ -490,6 +490,19 @@ class MicroBatcher:
             self._cv.notify_all()  # room for blocked producers
         return out
 
+    def reap_expired(self) -> int:
+        """Expire deadline-passed / stale queued requests NOW without
+        popping a batch.  Consumers whose take cadence is not their
+        expiry cadence call this at their own boundaries — the
+        generation engine's decode loop can run with every slot busy
+        for seconds while queued prompts' deadlines lapse, and poll()
+        (which would also TAKE work) only runs when a slot frees.
+        Returns the number of requests expired."""
+        with self._cv:
+            fire = self._collect_expired(self.clock())
+        self._fire_expired(fire)
+        return len(fire)
+
     def poll(self) -> Optional[List[Request]]:
         """Non-blocking `next_batch`: a coalesced batch if one is due
         (full, past the deadline, or draining after close), else None.

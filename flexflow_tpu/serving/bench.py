@@ -475,6 +475,13 @@ def run_serve_bench(requests: int = 512, rows_lo: int = 1, rows_hi: int = 8,
 
 
 def main(argv=None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--generate" in argv:
+        # token-generation benchmark: its own trace/flags
+        # (docs/serving.md "Token generation")
+        from .generation.bench import main as gen_main
+        gen_main([a for a in argv if a != "--generate"])
+        return
     ap = argparse.ArgumentParser(
         prog="flexflow-tpu serve-bench",
         description="serving-engine microbenchmark: shape-bucketed AOT "

@@ -39,3 +39,12 @@ class DeadlineExceeded(ServingError):
     the batcher expired it BEFORE packing, so no device dispatch was
     burned on an answer nobody is waiting for.  Delivered through the
     request's future."""
+
+
+class GenerationCancelled(ServingError):
+    """A token-generation stream was cancelled — by its client
+    (``GenerationStream.cancel()``) or the ``serve_cancel_at_token``
+    fault — while decoding.  The stream's KV slot is freed immediately
+    and ONLY this stream fails; tokens already streamed remain valid.
+    Delivered through the stream's future (docs/serving.md "Token
+    generation")."""

@@ -1,0 +1,13 @@
+"""flexflow_tpu.serving.generation — the token-generation subsystem
+(docs/serving.md "Token generation"): KV-cached autoregressive decode
+over an FFModel graph, an iteration-level continuous-batching
+:class:`GenerationEngine` with streaming outputs, and strategy-sharded
+serving (``GenerationEngine.from_strategy`` turns a searched ``.pb``
+into PartitionSpecs for params AND the KV cache and decodes
+tensor-parallel over the mesh)."""
+
+from .decoder import GraphDecoder
+from .engine import GenerationEngine, GenerationMetrics, GenerationStream
+
+__all__ = ["GenerationEngine", "GenerationStream", "GenerationMetrics",
+           "GraphDecoder"]

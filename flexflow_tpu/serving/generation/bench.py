@@ -1,0 +1,413 @@
+"""``serve-bench --generate`` — token-generation benchmark: continuous
+batching vs static run-to-completion batching, plus an SLO-goodput
+sweep (docs/serving.md "Token generation").
+
+The claim under test is the continuous-batching scheduler itself: with
+MIXED output lengths, a run-to-completion batch wastes every slot whose
+stream finished early (a batch of 8 decodes until its LONGEST stream is
+done), while iteration-level scheduling backfills freed slots from the
+queue at every step boundary.  Both arms run the exact same compiled
+prefill/decode programs (GraphDecoder) on the same trace, so the ratio
+isolates the scheduler:
+
+1. **continuous** — the GenerationEngine, all requests submitted
+   back-to-back (max rate): tokens/s plus TTFT (submit -> first token)
+   and TPOT (decode-step wall time) percentiles;
+2. **static** — groups of ``slots`` requests in arrival order, each
+   group prefilled then decoded until EVERY member reached its own
+   token budget (finished members idle in their slots — the
+   run-to-completion waste being measured);
+3. **SLO sweep** (``--slo-sweep``) — offered load at multiples of the
+   measured capacity under fifo (unbounded, no deadlines) vs
+   shed_oldest (bounded queue + TTFT deadline, PR 8's admission carried
+   over): goodput = tokens of requests that completed with TTFT within
+   the SLO.
+
+Every row stamps ``device_kind``, ``calibration_digest`` and
+``comm_plan_digest`` (PR 7/PR 9 conventions).  Artifact:
+``artifacts/serve_generate_r11.json``; the acceptance shape is
+continuous >= 2x static tokens/s on the mixed-length trace, and
+engine == replicated predict-style decode token-for-token.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+VOCAB = 128
+
+
+def _build_lm(slots: int, max_seq: int, d_model: int, num_heads: int,
+              num_layers: int, seed: int):
+    import flexflow_tpu as ff
+    from flexflow_tpu.models import build_transformer_lm
+    from flexflow_tpu.parallel.mesh import MachineMesh
+
+    cfg = ff.FFConfig(batch_size=4, compute_dtype="float32", seed=seed)
+    cfg.serve_gen_slots = slots
+    cfg.serve_gen_max_seq = max_seq
+    m = build_transformer_lm(
+        cfg, num_layers=num_layers, d_model=d_model, num_heads=num_heads,
+        d_ff=4 * d_model, seq_len=max_seq, vocab_size=VOCAB)[0]
+    m.compile(ff.SGDOptimizer(lr=0.01), mesh=MachineMesh({"n": 1}))
+    m.init_layers(seed=seed)
+    return m
+
+
+def make_gen_trace(n: int, prompt_lo: int, prompt_hi: int,
+                   short_new: int, long_new: int, long_frac: float,
+                   seed: int) -> List[Tuple[np.ndarray, int]]:
+    """The mixed-output-length trace: (prompt, max_new_tokens) pairs.
+    Bimodal budgets — mostly short answers with a long tail — are the
+    regime where run-to-completion batching wastes the most slot-steps
+    (every group decodes to its longest member)."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        plen = int(rng.integers(prompt_lo, prompt_hi + 1))
+        prompt = rng.integers(1, VOCAB, plen).astype(np.int32)
+        max_new = long_new if rng.random() < long_frac else short_new
+        out.append((prompt, int(max_new)))
+    return out
+
+
+def _pctl(vals: List[float]) -> Dict[str, Optional[float]]:
+    from flexflow_tpu.profiling import quantiles
+    q = quantiles(vals)
+
+    def ms(v):
+        return None if v != v else round(v * 1e3, 3)
+
+    return {"p50_ms": ms(q[0.5]), "p95_ms": ms(q[0.95]),
+            "p99_ms": ms(q[0.99])}
+
+
+def run_continuous(model, trace, slots: int, max_seq: int,
+                   stamp: Dict) -> Tuple[Dict, List[List[int]]]:
+    """Phase 1: the GenerationEngine at max rate."""
+    from .engine import GenerationEngine
+
+    eng = GenerationEngine(model, slots=slots, max_seq=max_seq,
+                           stats_every=0)
+    useful = sum(mn for _, mn in trace)
+    with eng:
+        t0 = time.perf_counter()
+        streams = [eng.submit(p, max_new_tokens=mn) for p, mn in trace]
+        outs = [list(int(t) for t in s.result(timeout=600))
+                for s in streams]
+        dt = time.perf_counter() - t0
+    snap = eng.stats()
+    ttfts = [s.ttft for s in streams if s.ttft is not None]
+    row = {
+        "makespan_s": round(dt, 4),
+        "requests": len(trace),
+        "tokens": useful,
+        "tokens_per_s": round(useful / dt, 2),
+        "requests_per_s": round(len(trace) / dt, 2),
+        "ttft": _pctl(ttfts),
+        "tpot_p50_ms": snap["tpot_p50_ms"],
+        "tpot_p95_ms": snap["tpot_p95_ms"],
+        "tpot_p99_ms": snap["tpot_p99_ms"],
+        **stamp,
+    }
+    return row, outs
+
+
+def run_static(model, trace, slots: int, max_seq: int,
+               stamp: Dict) -> Tuple[Dict, List[List[int]]]:
+    """Phase 2: run-to-completion batching over the SAME compiled
+    programs — groups of ``slots`` requests decode until the group's
+    longest budget is exhausted; early finishers idle in their slots."""
+    import jax
+
+    from .decoder import GraphDecoder
+
+    dec = GraphDecoder.for_model(model, slots, max_seq)
+    caches = dec.init_cache()
+    outs: List[List[int]] = []
+    useful = sum(mn for _, mn in trace)
+    steps = 0
+    groups = 0
+    t0 = time.perf_counter()
+    for g0 in range(0, len(trace), slots):
+        group = trace[g0:g0 + slots]
+        groups += 1
+        states = []
+        for i, (prompt, max_new) in enumerate(group):
+            bucket = dec.prefill_bucket(prompt.size)
+            tok = np.zeros((1, bucket), np.int32)
+            tok[0, :prompt.size] = prompt
+            first, caches = dec.prefill_fn(bucket)(
+                model._params, caches, tok, np.int32(i),
+                np.int32(prompt.size))
+            states.append({
+                "last": int(jax.device_get(first)),
+                "len": int(prompt.size), "gen": 1, "max": max_new,
+                "out": [int(jax.device_get(first))]})
+        # run to completion: the WHOLE group steps until its longest
+        # member is done — the waste continuous batching removes
+        while any(st["gen"] < st["max"] for st in states):
+            toks = np.zeros((slots,), np.int32)
+            pos = np.zeros((slots,), np.int32)
+            for i, st in enumerate(states):
+                toks[i] = st["last"]
+                pos[i] = min(st["len"], max_seq - 1)
+            nxt, caches = dec.decode_fn()(model._params, caches, toks,
+                                          pos)
+            host = np.asarray(jax.device_get(nxt))
+            steps += 1
+            for i, st in enumerate(states):
+                st["len"] += 1
+                if st["gen"] < st["max"]:
+                    st["last"] = int(host[i])
+                    st["gen"] += 1
+                    st["out"].append(int(host[i]))
+        outs.extend(st["out"] for st in states)
+    dt = time.perf_counter() - t0
+    return {
+        "makespan_s": round(dt, 4),
+        "requests": len(trace),
+        "tokens": useful,
+        "tokens_per_s": round(useful / dt, 2),
+        "groups": groups,
+        "decode_steps": steps,
+        "slot_steps": steps * slots,
+        "slot_efficiency": round(useful / max(1, steps * slots), 4),
+        **stamp,
+    }, outs
+
+
+def reference_decode(model, prompt: np.ndarray, max_new: int,
+                     max_seq: int) -> List[int]:
+    """Replicated predict-style decode: full forward over the padded
+    prompt at every step, argmax the last position — the parity
+    reference the engine must reproduce token-for-token."""
+    toks = [int(t) for t in prompt]
+    for _ in range(max_new):
+        padded = np.zeros((1, max_seq), np.int32)
+        padded[0, :len(toks)] = toks
+        probs = model.predict([padded], batch_size=2)
+        toks.append(int(np.argmax(probs[0, len(toks) - 1])))
+    return toks[len(prompt):]
+
+
+def run_slo_cell(model, trace, slots: int, max_seq: int, rate: float,
+                 policy: str, slo_ms: float, queue_bound: int,
+                 seed: int, stamp: Dict) -> Dict:
+    """One SLO-sweep cell: Poisson arrivals at ``rate`` req/s; goodput
+    counts tokens of requests that completed with TTFT <= slo."""
+    from ..bench import make_arrivals
+    from ..errors import ServingError
+    from .engine import GenerationEngine
+
+    bounded = policy != "fifo"
+    eng = GenerationEngine(
+        model, slots=slots, max_seq=max_seq, stats_every=0,
+        max_queue_requests=queue_bound if bounded else 0,
+        admission="shed_oldest" if bounded else "block")
+    arrivals = make_arrivals(len(trace), rate, seed, burst=1)
+    entries = []
+    t0 = time.perf_counter()
+    with eng:
+        for (prompt, max_new), at in zip(trace, arrivals):
+            lag = t0 + at - time.perf_counter()
+            if lag > 0:
+                time.sleep(lag)
+            try:
+                s = eng.submit(prompt, max_new_tokens=max_new,
+                               deadline_ms=slo_ms if bounded else None)
+            except ServingError:
+                continue  # rejected at admission (counted engine-side)
+            entries.append((s, max_new))
+        eng.drain(timeout=max(2.0, 16 * slo_ms / 1e3))
+    elapsed = max(1e-6, time.perf_counter() - t0)
+    snap = eng.stats()
+    good_tokens = 0
+    completed = 0
+    for s, max_new in entries:
+        if s.future.done() and s.future.exception() is None \
+                and not s.future.cancelled():
+            completed += 1
+            if s.ttft is not None and s.ttft * 1e3 <= slo_ms:
+                good_tokens += len(s.tokens_so_far())
+    return {
+        "policy": policy,
+        "offered_rps": round(rate, 2),
+        "offered_requests": len(trace),
+        "slo_ms": round(slo_ms, 3),
+        "queue_bound": queue_bound if bounded else 0,
+        "elapsed_s": round(elapsed, 4),
+        "completed": completed,
+        "goodput_tokens_per_s": round(good_tokens / elapsed, 2),
+        "rejected": snap["rejected"],
+        "shed": snap["shed"],
+        "expired": snap["expired"],
+        "peak_queue_requests": snap["peak_queue_requests"],
+        **stamp,
+    }
+
+
+def run_generate_bench(requests: int = 96, slots: int = 8,
+                       max_seq: int = 128, prompt_lo: int = 2,
+                       prompt_hi: int = 8, short_new: int = 4,
+                       long_new: int = 96, long_frac: float = 0.125,
+                       d_model: int = 64, num_heads: int = 4,
+                       num_layers: int = 2, seed: int = 0,
+                       parity_checks: int = 2, slo_sweep: bool = True,
+                       slo_ms: float = 0.0,
+                       mults=(0.5, 1.0, 2.0),
+                       calibration_digest=None) -> Dict:
+    """The full --generate payload."""
+    import jax
+
+    from ...analysis import comm_plan_digest_for_model
+    from ...search.calibration import device_kind as _device_kind
+
+    model = _build_lm(slots, max_seq, d_model, num_heads, num_layers,
+                     seed)
+    trace = make_gen_trace(requests, prompt_lo, prompt_hi, short_new,
+                           long_new, long_frac, seed)
+    dk = _device_kind()
+    stamp = {"device_kind": dk, "calibration_digest": calibration_digest,
+             "comm_plan_digest": comm_plan_digest_for_model(model)}
+
+    # the first engine's start() compiles every bucket + the decode
+    # step (engine warmup); the decoder cache shares those programs
+    # with every later engine AND the static arm, so both timed phases
+    # run fully warm
+    cont_row, cont_outs = run_continuous(model, trace, slots, max_seq,
+                                         stamp)
+    static_row, static_outs = run_static(model, trace, slots, max_seq,
+                                         stamp)
+    # scheduler isolation check: both arms decode the same tokens
+    scheds_agree = all(a == b for a, b in zip(cont_outs, static_outs))
+
+    # engine == replicated predict-style decode, token for token (a
+    # greedy stream's first k tokens never depend on later ones, so a
+    # bounded prefix check pins the whole trajectory class)
+    parity = True
+    for i, (prompt, max_new) in enumerate(trace[:parity_checks]):
+        want = reference_decode(model, prompt, min(max_new, 8), max_seq)
+        if cont_outs[i][:len(want)] != want:
+            parity = False
+            break
+
+    cells = []
+    eff_slo = slo_ms
+    if slo_sweep:
+        capacity = cont_row["requests_per_s"]
+        if eff_slo <= 0:
+            p95 = cont_row["ttft"]["p95_ms"] or 50.0
+            eff_slo = max(50.0, 4 * p95)
+        for mult in mults:
+            rate = max(0.5, capacity * mult)
+            n = max(8, min(len(trace), int(rate * 2.0)))
+            for policy in ("fifo", "shed_oldest"):
+                cells.append(run_slo_cell(
+                    model, trace[:n], slots, max_seq, rate, policy,
+                    eff_slo, 2 * slots, seed + len(cells), stamp)
+                    | {"offered_mult": mult})
+
+    payload = {
+        "bench": "serve-generate",
+        "backend": jax.default_backend(),
+        "estimator": "measured",
+        **stamp,
+        "config": {
+            "requests": requests, "slots": slots, "max_seq": max_seq,
+            "prompt": f"{prompt_lo}-{prompt_hi}",
+            "short_new": short_new, "long_new": long_new,
+            "long_frac": long_frac, "d_model": d_model,
+            "num_heads": num_heads, "num_layers": num_layers,
+            "seed": seed, "vocab": VOCAB,
+        },
+        "continuous": cont_row,
+        "static": static_row,
+        "speedup_tokens": round(
+            cont_row["tokens_per_s"]
+            / max(1e-6, static_row["tokens_per_s"]), 2),
+        "parity": {"reference_checks": parity_checks,
+                   "engine_eq_reference": bool(parity),
+                   "schedulers_agree": bool(scheds_agree)},
+        "slo_sweep": {"slo_ms": round(eff_slo, 3), "cells": cells}
+        if slo_sweep else None,
+    }
+    return payload
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        prog="flexflow-tpu serve-bench --generate",
+        description="token-generation benchmark: continuous batching "
+                    "vs run-to-completion + SLO-goodput sweep "
+                    "(docs/serving.md 'Token generation')")
+    ap.add_argument("--requests", type=int, default=96)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--max-seq", type=int, default=128)
+    ap.add_argument("--prompt", default="2-8",
+                    help="prompt-length range, e.g. 2-8")
+    ap.add_argument("--short-new", type=int, default=4)
+    ap.add_argument("--long-new", type=int, default=96)
+    ap.add_argument("--long-frac", type=float, default=0.125,
+                    help="fraction of requests with the long token "
+                         "budget (the chat-like mostly-short mix)")
+    ap.add_argument("--d-model", type=int, default=64)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--no-slo-sweep", action="store_true")
+    ap.add_argument("--slo-ms", type=float, default=0.0,
+                    help="TTFT SLO for the goodput sweep (0 = auto "
+                         "from the measured continuous-phase TTFT)")
+    ap.add_argument("--mults", default="0.5,1,2")
+    ap.add_argument("--calibration", default="",
+                    help="CalibrationTable JSON whose digest the "
+                         "payload records")
+    ap.add_argument("--out", default="",
+                    help="also write the JSON artifact here")
+    args = ap.parse_args(argv)
+    try:
+        lo, hi = (int(v) for v in args.prompt.split("-"))
+        mults = tuple(float(v) for v in args.mults.split(",")
+                      if v.strip())
+    except ValueError:
+        ap.error(f"bad --prompt {args.prompt!r} or --mults "
+                 f"{args.mults!r}")
+    if not (1 <= lo <= hi):
+        ap.error(f"--prompt wants 1 <= LO <= HI, got {args.prompt!r}")
+    digest = None
+    if args.calibration:
+        from ...search.calibration import CalibrationTable
+        try:
+            digest = CalibrationTable.load(args.calibration).digest
+        except (OSError, ValueError) as e:
+            ap.error(f"cannot load --calibration "
+                     f"{args.calibration!r}: {e}")
+
+    from ...fflogger import silenced
+    with silenced("ff", "serve"):
+        payload = run_generate_bench(
+            requests=args.requests, slots=args.slots,
+            max_seq=args.max_seq, prompt_lo=lo, prompt_hi=hi,
+            short_new=args.short_new, long_new=args.long_new,
+            long_frac=args.long_frac, d_model=args.d_model,
+            num_heads=args.heads, num_layers=args.layers,
+            seed=args.seed, slo_sweep=not args.no_slo_sweep,
+            slo_ms=args.slo_ms, mults=mults,
+            calibration_digest=digest)
+    text = json.dumps(payload, indent=2)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+        print(f"# wrote {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,308 @@
+"""GraphDecoder — autoregressive execution of an FFModel graph.
+
+The training/serving executor runs the graph at full sequence length;
+generation needs the same graph one position at a time.  This module
+derives both halves from the layer list itself:
+
+* **prefill** — the full forward over a (1, bucket) padded prompt,
+  through each op's own forward arithmetic (attention uses
+  ``forward_kv``, the LSTM ``forward_states`` — bit-identical to
+  ``forward``), while capturing the per-position K/V (attention) and
+  per-step (h, c) (LSTM) the decode cache is seeded from.  Bucketed:
+  one AOT-style jitted program per power-of-two prompt bucket, like the
+  serving engine's shape buckets.
+* **decode** — ONE jitted step for the whole ``slots``-wide decode
+  batch: embed the current token per slot, run every layer's
+  single-position path (``Op.decode``), write K/V at each slot's
+  position, argmax the next token.  The cache pytree is donated, so
+  XLA updates the (potentially multi-GB) buffers in place.
+
+Cache geometry and sharding come from
+:mod:`flexflow_tpu.analysis.kv_memory` — the SAME module the static
+FF108/FF121 memory gates integrate, so what lint predicts is what this
+decoder allocates.  Heads shard over the tensor-parallel ``c`` mesh
+axis, slots over the data axis ``n`` (never below 2 slots/shard — the
+matrix-vector parity rule).
+
+Supported graphs: one (n, s) int token input; position-wise ops
+(dense/norms/elementwise/softmax/dropout/embedding), causal
+self-attention, stateless-init LSTM, learned position embeddings.
+Anything else (convs, splits, cross-attention, MoE, pipelines) fails
+validation loudly at construction — a generation engine must never
+silently produce wrong tokens for an unsupported graph.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...analysis.kv_memory import kv_cache_layout
+from ...op import OpContext, OpType
+from ...ops.attention import MultiHeadAttention, PositionEmbedding
+from ...ops.linear import Embedding
+from ...ops.rnn import LSTM
+
+# ops that act position-wise over the sequence dim: running them on a
+# (slots, 1, d) activation IS the decode step (validated per-op below)
+_POINTWISE_TYPES = (OpType.LINEAR, OpType.LAYERNORM, OpType.RMSNORM,
+                    OpType.ELEMENT_UNARY, OpType.ELEMENT_BINARY,
+                    OpType.SOFTMAX, OpType.DROPOUT)
+
+
+def prefill_buckets(max_seq: int) -> Tuple[int, ...]:
+    """Power-of-two prompt buckets 2, 4, ... capped at ``max_seq``
+    (always included) — one compiled prefill program per bucket."""
+    out: List[int] = []
+    b = 2
+    while b < max_seq:
+        out.append(b)
+        b *= 2
+    out.append(int(max_seq))
+    return tuple(out)
+
+
+class GraphDecoder:
+    """Prefill + decode executables for one (model, slots, max_seq)
+    geometry.  Use :meth:`for_model` — instances cache their jitted
+    programs, and engines sharing a geometry share the compiles."""
+
+    def __init__(self, model, slots: int, max_seq: int):
+        if slots < 2:
+            raise ValueError(
+                f"slots must be >= 2, got {slots}: a 1-slot decode "
+                f"batch lowers matrix-vector kernels whose bits differ "
+                f"from the full forward (same floor as serve_buckets)")
+        self.model = model
+        self.slots = int(slots)
+        self.max_seq = int(max_seq)
+        self._validate()
+        self.buckets = prefill_buckets(self.max_seq)
+        mesh = model.mesh
+        self._mesh_sizes = dict(mesh.sizes) if mesh is not None else None
+        self.layout = kv_cache_layout(model.layers, self._mesh_sizes,
+                                      self.slots, self.max_seq)
+        self._prefill_fns: Dict[int, object] = {}
+        self._decode_fn = None
+
+    # ---- validation ----------------------------------------------------
+    def _validate(self) -> None:
+        model = self.model
+        if len(model.input_tensors) != 1:
+            raise ValueError(
+                f"generation needs exactly one token input, model has "
+                f"{len(model.input_tensors)}")
+        tin = model.input_tensors[0]
+        if len(tin.shape) != 2 or not np.issubdtype(np.dtype(tin.dtype),
+                                                    np.integer):
+            raise ValueError(
+                f"generation input must be (n, s) integer token ids, "
+                f"got {tin.shape} {tin.dtype}")
+        self._input_uid = tin.uid
+        final = getattr(model, "_final_tensor", None) or \
+            model.layers[-1].outputs[0]
+        if len(final.shape) != 3:
+            raise ValueError(
+                f"generation needs per-token (n, s, vocab) outputs, "
+                f"final tensor is {final.shape} — use an LM graph "
+                f"(models.build_transformer_lm / build_lstm_lm), not a "
+                f"classifier")
+        self._final_uid = final.uid
+        for op in model.layers:
+            if isinstance(op, MultiHeadAttention):
+                if not (op._self_attn and op.causal):
+                    raise ValueError(
+                        f"{op.name}: generation needs causal "
+                        f"self-attention (cross-attention/bidirectional "
+                        f"blocks cannot decode autoregressively)")
+            elif isinstance(op, PositionEmbedding):
+                if op.max_len < self.max_seq:
+                    raise ValueError(
+                        f"{op.name}: position table holds {op.max_len} "
+                        f"positions < max_seq {self.max_seq}")
+            elif isinstance(op, LSTM):
+                if op._has_state:
+                    raise ValueError(
+                        f"{op.name}: LSTM with an external initial_state "
+                        f"is not decodable (seed states are a prefill "
+                        f"product, not a graph input)")
+            elif isinstance(op, Embedding):
+                if op.aggr != "none":
+                    raise ValueError(
+                        f"{op.name}: only sequence-mode (aggr='none') "
+                        f"embeddings decode; bag aggregation collapses "
+                        f"the sequence dim")
+            elif op.op_type not in _POINTWISE_TYPES:
+                raise ValueError(
+                    f"{op.name} ({op.op_type.value}) has no "
+                    f"single-position decode path; generation supports "
+                    f"causal attention, LSTM, embeddings and "
+                    f"position-wise ops")
+
+    # ---- shared context ------------------------------------------------
+    def _ctx(self) -> OpContext:
+        cfg = self.model.config
+        return OpContext(
+            training=False, rng=None, compute_dtype=cfg.compute_dtype,
+            mesh=self.model.mesh, flash_attention=cfg.flash_attention,
+            conv_layout=getattr(self.model, "resolved_conv_layout",
+                                "nchw"))
+
+    # ---- cache ---------------------------------------------------------
+    def init_cache(self) -> Dict[str, Dict[str, jax.Array]]:
+        """Preallocate the per-slot decode state, placed under the
+        layout's PartitionSpecs (analysis.kv_memory — the bytes the
+        FF108/FF121 gates charge are exactly these allocations)."""
+        from jax.sharding import PartitionSpec
+
+        mesh = self.model.mesh
+        compute_dt = jnp.dtype(self.model.config.compute_dtype)
+        caches: Dict[str, Dict[str, jax.Array]] = {}
+        for name, ent in self.layout.items():
+            dt = compute_dt if ent["dtype"] == "compute" else jnp.float32
+            sub: Dict[str, jax.Array] = {}
+            for leaf, shape in ent["shapes"].items():
+                arr = jnp.zeros(shape, dt)
+                if mesh is not None and mesh.is_distributed:
+                    arr = jax.device_put(
+                        arr,
+                        mesh.sharding(PartitionSpec(
+                            *ent["entries"][leaf])))
+                sub[leaf] = arr
+            caches[name] = sub
+        return caches
+
+    # ---- prefill -------------------------------------------------------
+    def prefill_bucket(self, prompt_len: int) -> int:
+        """Smallest prompt bucket covering ``prompt_len``."""
+        for b in self.buckets:
+            if b >= prompt_len:
+                return b
+        raise ValueError(f"prompt of {prompt_len} tokens exceeds "
+                         f"max_seq {self.max_seq}")
+
+    def _walk_prefill(self, params, tokens):
+        """Full forward over (1, bucket) tokens, collecting each
+        cache-bearing op's seed tensors.  Runs the ops' OWN forward
+        arithmetic (forward_kv/forward_states are forward plus extra
+        outputs), so prefill == the training executor's forward."""
+        ctx = self._ctx()
+        values: Dict[int, jax.Array] = {self._input_uid: tokens}
+        seeds: Dict[str, Dict[str, jax.Array]] = {}
+        for op in self.model.layers:
+            ins = [values[t.uid] for t in op.inputs]
+            if isinstance(op, MultiHeadAttention):
+                outs, k, v = op.forward_kv(params, ins, ctx)
+                seeds[op.name] = {"k": k, "v": v}
+            elif isinstance(op, LSTM):
+                outs, hs, cs = op.forward_states(params, ins, ctx)
+                seeds[op.name] = {"hs": hs, "cs": cs}
+            else:
+                outs = op.forward(params, ins, ctx)
+            for t, val in zip(op.outputs, outs):
+                values[t.uid] = val
+        return values[self._final_uid], seeds
+
+    def prefill_fn(self, bucket: int):
+        """The jitted prefill program for one prompt bucket:
+        ``fn(params, caches, tokens (1, bucket), slot, length) ->
+        (first_token, caches)`` — runs the full forward, writes the
+        slot's K/V rows / gathers its (h, c) at ``length - 1``, and
+        argmaxes the last prompt position's logits (the stream's FIRST
+        generated token, so TTFT is one prefill dispatch).  The cache
+        pytree is donated."""
+        fn = self._prefill_fns.get(bucket)
+        if fn is not None:
+            return fn
+        if bucket not in self.buckets:
+            raise ValueError(f"unknown prefill bucket {bucket}")
+
+        def prefill(params, caches, tokens, slot, length):
+            logits, seeds = self._walk_prefill(params, tokens)
+            new = {name: dict(sub) for name, sub in caches.items()}
+            for name, seed in seeds.items():
+                if "k" in seed:
+                    new[name]["k"] = jax.lax.dynamic_update_slice(
+                        new[name]["k"], seed["k"], (slot, 0, 0, 0))
+                    new[name]["v"] = jax.lax.dynamic_update_slice(
+                        new[name]["v"], seed["v"], (slot, 0, 0, 0))
+                else:
+                    h_sel = jax.lax.dynamic_index_in_dim(
+                        seed["hs"], length - 1, axis=1, keepdims=False)
+                    c_sel = jax.lax.dynamic_index_in_dim(
+                        seed["cs"], length - 1, axis=1, keepdims=False)
+                    new[name]["h"] = jax.lax.dynamic_update_slice(
+                        new[name]["h"], h_sel, (slot, 0))
+                    new[name]["c"] = jax.lax.dynamic_update_slice(
+                        new[name]["c"], c_sel, (slot, 0))
+            last = jax.lax.dynamic_index_in_dim(
+                logits, length - 1, axis=1, keepdims=False)[0]
+            first = jnp.argmax(last).astype(jnp.int32)
+            return first, new
+
+        fn = jax.jit(prefill, donate_argnums=(1,))
+        self._prefill_fns[bucket] = fn
+        return fn
+
+    # ---- decode --------------------------------------------------------
+    def decode_fn(self):
+        """THE decode step, jitted once per geometry:
+        ``fn(params, caches, tokens (slots,), pos (slots,)) ->
+        (next_tokens (slots,), caches)``.  Every slot advances one
+        position per call — inactive slots compute on dummy inputs
+        (their cache rows are dead and rewritten at the next prefill),
+        which keeps the program shape static.  Greedy argmax decoding:
+        deterministic, and exactly what the replicated
+        ``predict``-style reference does — the engine==reference parity
+        pin compares token ids."""
+        if self._decode_fn is not None:
+            return self._decode_fn
+        layers = self.model.layers
+
+        def decode(params, caches, tokens, pos):
+            ctx = self._ctx()
+            x = tokens[:, None]                          # (slots, 1)
+            values: Dict[int, jax.Array] = {self._input_uid: x}
+            new: Dict[str, Dict[str, jax.Array]] = {}
+            for op in layers:
+                ins = [values[t.uid] for t in op.inputs]
+                if isinstance(op, MultiHeadAttention):
+                    outs, k2, v2 = op.decode(
+                        params, ins[0], caches[op.name]["k"],
+                        caches[op.name]["v"], pos, ctx)
+                    new[op.name] = {"k": k2, "v": v2}
+                elif isinstance(op, LSTM):
+                    outs, h2, c2 = op.decode(
+                        params, ins[0], caches[op.name]["h"],
+                        caches[op.name]["c"], ctx)
+                    new[op.name] = {"h": h2, "c": c2}
+                elif isinstance(op, PositionEmbedding):
+                    outs = op.decode(params, ins[0], pos, ctx)
+                else:
+                    outs = op.forward(params, ins, ctx)
+                for t, val in zip(op.outputs, outs):
+                    values[t.uid] = val
+            logits = values[self._final_uid][:, 0]       # (slots, V)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return nxt, new
+
+        self._decode_fn = jax.jit(decode, donate_argnums=(1,))
+        return self._decode_fn
+
+    # ---- shared-instance registry --------------------------------------
+    @classmethod
+    def for_model(cls, model, slots: int, max_seq: int) -> "GraphDecoder":
+        """One decoder per (model, slots, max_seq): engines sharing a
+        geometry share the jitted prefill/decode programs (the compile
+        cost is the startup cost, like the serving engine's bucket
+        warmup)."""
+        reg = model.__dict__.setdefault("_gen_decoders", {})
+        key = (int(slots), int(max_seq))
+        dec = reg.get(key)
+        if dec is None:
+            dec = cls(model, slots, max_seq)
+            reg[key] = dec
+        return dec

@@ -1,0 +1,811 @@
+"""GenerationEngine — iteration-level continuous batching over the
+KV-cached decode path (docs/serving.md "Token generation").
+
+The fixed-shape :class:`~flexflow_tpu.serving.engine.ServingEngine`
+coalesces whole requests into one dispatch; token generation is a
+different shape of problem — a request is a *stream* whose cost is
+unknown up front (EOS may land anywhere).  Run-to-completion batching
+wastes every slot whose stream finished early, so this engine schedules
+at ITERATION granularity: a fixed ``slots``-wide decode batch shares
+one preallocated KV cache, requests join a free slot at any step
+boundary (one bucketed prefill dispatch seeds the slot and yields the
+stream's first token — that's TTFT), every step runs ONE decode
+dispatch + ONE token fetch for the whole batch (repo_lint RL010 bans
+any other host sync in the loop), and a finished/cancelled stream frees
+its slot for the next queued prompt immediately.
+
+Admission reuses PR 8's machinery unchanged: the same
+:class:`~flexflow_tpu.serving.batcher.MicroBatcher` (1 row per request)
+provides the bounded queue with block/reject/shed_oldest policies,
+per-request deadlines (a prompt still queued past its deadline expires
+BEFORE any prefill is burned) and priority classes with the
+anti-starvation aging bound — overload semantics carry over verbatim.
+
+Strategy-sharded serving: :meth:`GenerationEngine.from_strategy` loads
+a searched ``.pb``, re-places the params under the strategy's
+PartitionSpecs (the SNIPPETS partition-rule → spec-pytree pattern) and
+shards the KV cache heads over the ``c`` mesh axis / slots over ``n``
+(analysis.kv_memory), so one checkpoint decodes tensor-parallel over
+whatever mesh the strategy was searched for.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ... import faults
+from ...compile_cache import enable as _enable_compile_cache
+from ...fflogger import get_logger
+from ...profiling import quantiles
+from ..batcher import MicroBatcher, Request
+from ..errors import GenerationCancelled, OverloadError, SheddedError
+from ..metrics import ServingMetrics
+from .decoder import GraphDecoder
+
+_END = object()  # token-stream sentinel
+
+
+def _resolve(fut: Future, out) -> bool:
+    """Complete a stream future with a result or exception, from EITHER
+    lifecycle state: pending (failure paths fire before the engine
+    claimed it at prefill) or running (the decode loop claimed it).
+    Unlike the serving engine's ``_resolve_future`` this must NOT call
+    ``set_running_or_notify_cancel`` — on an already-claimed (RUNNING)
+    future that raises and would silently swallow the resolution.
+    Cancelled/finished futures return False (client interference is a
+    drop, never a dispatcher-thread exception)."""
+    try:
+        if isinstance(out, BaseException):
+            fut.set_exception(out)
+        else:
+            fut.set_result(out)
+        return True
+    except Exception:  # noqa: BLE001 — InvalidStateError & kin
+        return False
+
+
+class GenerationStream:
+    """Client handle for one generation request: iterate it for tokens
+    as they retire per decode step, or wait on :meth:`result` for the
+    full sequence.
+
+    ::
+
+        stream = engine.submit([1, 2, 3], max_new_tokens=16)
+        for tok in stream:          # yields as decode steps complete
+            ...
+        final = stream.result()     # np.int32 array of all new tokens
+
+    ``cancel()`` is safe at any time: a queued request is dropped
+    before any prefill; a mid-generation cancel frees its KV slot at
+    the next step boundary and fails ONLY this stream with
+    :class:`~flexflow_tpu.serving.errors.GenerationCancelled` — tokens
+    already iterated remain valid."""
+
+    def __init__(self, prompt_len: int, max_new: int, t_submit: float,
+                 deadlined: bool = False):
+        self.future: Future = Future()
+        self.prompt_len = int(prompt_len)
+        self.max_new = int(max_new)
+        self.t_submit = t_submit
+        self.deadlined = deadlined
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._tokens: List[int] = []  # engine-thread writes, then frozen
+        self._cancelled = threading.Event()
+        # submit -> first token, set by the engine at prefill (None
+        # until then) — per-stream SLO evidence for the goodput sweep
+        self.ttft: Optional[float] = None
+
+    # ---- client side ---------------------------------------------------
+    def cancel(self) -> None:
+        """Request cancellation.  Queued: the engine drops the request
+        without a prefill (the future flips cancelled).  Generating:
+        the slot frees at the next step boundary and the future fails
+        with GenerationCancelled."""
+        self._cancelled.set()
+        # succeeds only while still queued (the engine claims the
+        # future before prefill); a claimed future fails at the next
+        # step boundary instead
+        if self.future.cancel():
+            self._q.put(_END)
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    def tokens_so_far(self) -> List[int]:
+        """Snapshot of the tokens retired so far (grows per step)."""
+        return list(self._tokens)
+
+    def __iter__(self):
+        while True:
+            item = self._q.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """The full generated sequence (np.int32, length <= max_new) —
+        blocks until EOS/max-tokens; raises the stream's failure."""
+        return self.future.result(timeout)
+
+    # ---- engine side ---------------------------------------------------
+    def _emit(self, tok: int) -> None:
+        self._tokens.append(tok)
+        self._q.put(tok)
+
+    def _finish(self) -> bool:
+        done = _resolve(self.future, np.asarray(self._tokens, np.int32))
+        self._q.put(_END)
+        return done
+
+    def _fail(self, exc: BaseException) -> bool:
+        done = _resolve(self.future, exc)
+        if done:
+            self._q.put(exc)
+        self._q.put(_END)
+        return done
+
+
+class _GenRequest(Request):
+    """A queued prompt: a 1-row batcher Request carrying its stream.
+
+    Deliberately NO ``stale=`` predicate: a cancelled-while-queued
+    stream is already dropped at join time (the engine's
+    ``set_running_or_notify_cancel`` claim fails on a cancelled
+    future, so no prefill is burned), and a stale hook on EVERY
+    request would flip the batcher's ``_watch`` fast path permanently
+    on — every ``reap_expired()``/``poll()`` the decode loop runs
+    would scan the whole queue under the lock even when nothing
+    carries a deadline."""
+
+    __slots__ = ("stream",)
+
+    def __init__(self, stream: GenerationStream, prompt: np.ndarray,
+                 on_done, t_submit: float, deadline=None, priority=0):
+        super().__init__((prompt,), 1, on_done, t_submit,
+                         deadline=deadline, priority=priority)
+        self.stream = stream
+
+
+class _Slot:
+    """Dispatcher-thread-only state of one active decode slot."""
+
+    __slots__ = ("stream", "last_token", "length", "generated")
+
+    def __init__(self, stream: GenerationStream, first_token: int,
+                 prompt_len: int):
+        self.stream = stream
+        self.last_token = first_token
+        self.length = prompt_len  # positions materialized in the cache
+        self.generated = 1        # prefill already yielded token #1
+
+
+class GenerationMetrics(ServingMetrics):
+    """ServingMetrics plus the generation gauges: windowed tokens/s,
+    TTFT (submit -> first token, i.e. queue wait + prefill) and TPOT
+    (decode-step wall time — the per-token latency every active stream
+    pays) percentiles, token/prefill totals.  Emitted as ``gen_stats``
+    events, the generation analogue of ``serve_stats``."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self._ttfts: deque = deque(maxlen=4096)  # guarded_by: self._lock
+        self._steps: deque = deque()             # guarded_by: self._lock
+        self.total_tokens = 0                    # guarded_by: self._lock
+        self.total_prefills = 0                  # guarded_by: self._lock
+
+    def record_ttft(self, seconds: float) -> None:
+        now = self.clock()
+        with self._lock:
+            self._ttfts.append((now, float(seconds)))
+            self.total_prefills += 1
+
+    def record_decode_step(self, ntokens: int, step_s: float) -> None:
+        now = self.clock()
+        with self._lock:
+            self._steps.append((now, int(ntokens), float(step_s)))
+            self.total_tokens += int(ntokens)
+            horizon = now - self.window_s
+            while self._steps and self._steps[0][0] < horizon:
+                self._steps.popleft()
+
+    def record_prefill_token(self) -> None:
+        """The prefill's first token counts toward tokens/s too."""
+        now = self.clock()
+        with self._lock:
+            self._steps.append((now, 1, 0.0))
+            self.total_tokens += 1
+            # trim here too: a max_new_tokens=1 workload never calls
+            # record_decode_step, and the window must stay bounded
+            horizon = now - self.window_s
+            while self._steps and self._steps[0][0] < horizon:
+                self._steps.popleft()
+
+    def snapshot(self) -> Dict:
+        snap = super().snapshot()
+        now = self.clock()
+        with self._lock:
+            steps = list(self._steps)
+            ttfts = [v for _, v in self._ttfts]
+            total_tokens = self.total_tokens
+            total_prefills = self.total_prefills
+        span = self.window_s
+        if steps:
+            span = min(self.window_s, max(1e-6, now - steps[0][0]))
+        toks = sum(s[1] for s in steps)
+        tpots = [s[2] for s in steps if s[2] > 0]
+        qt = quantiles(ttfts)
+        qp = quantiles(tpots)
+
+        def ms(v):
+            return None if v != v else round(v * 1e3, 3)
+
+        snap.update({
+            "tokens_per_s": round(toks / span, 3),
+            "tokens": total_tokens,
+            "prefills": total_prefills,
+            "ttft_p50_ms": ms(qt[0.5]), "ttft_p95_ms": ms(qt[0.95]),
+            "ttft_p99_ms": ms(qt[0.99]),
+            "tpot_p50_ms": ms(qp[0.5]), "tpot_p95_ms": ms(qp[0.95]),
+            "tpot_p99_ms": ms(qp[0.99]),
+        })
+        return snap
+
+    def emit(self, extra: Dict | None = None) -> None:
+        get_logger("serve").event("gen_stats", **self.snapshot(),
+                                  **(extra or {}))
+
+
+class GenerationEngine:
+    """Continuous-batching token generation over a compiled+initialized
+    FFModel LM graph.
+
+    ::
+
+        engine = GenerationEngine(model, slots=8, eos_id=0)
+        with engine:
+            stream = engine.submit(prompt_ids, max_new_tokens=32)
+            for tok in stream: ...
+            out = stream.result()
+
+    Knobs resolve from ``model.config`` (``--serve-gen-slots``,
+    ``--serve-gen-max-seq``, ``--serve-gen-max-new``, and PR 8's
+    ``--serve-max-queue-rows``/``--serve-admission``/
+    ``--serve-starvation-ms`` for admission — the queue bound counts
+    REQUESTS here, one row each) unless overridden.  ``clock``/``sleep``
+    are injectable for deterministic fault tests (RL008)."""
+
+    def __init__(self, model, slots: Optional[int] = None,
+                 max_seq: Optional[int] = None,
+                 max_new_tokens: Optional[int] = None,
+                 eos_id: Optional[int] = None,
+                 max_queue_requests: Optional[int] = None,
+                 admission: Optional[str] = None,
+                 starvation_ms: Optional[float] = None,
+                 stats_every: int = 32, metrics_window_s: float = 30.0,
+                 clock=time.monotonic, sleep=time.sleep):
+        assert model._compiled, "compile() + init_layers() the model first"
+        _enable_compile_cache()
+        cfg = model.config
+        self.model = model
+        self.slots = int(slots or cfg.serve_gen_slots)
+        seq_len = (model.input_tensors[0].shape[1]
+                   if model.input_tensors else 0)
+        self.max_seq = int(max_seq or cfg.serve_gen_max_seq or seq_len)
+        self.max_new_tokens = int(max_new_tokens
+                                  or cfg.serve_gen_max_new_tokens)
+        self.eos_id = eos_id
+        self.clock = clock
+        self._sleep = sleep
+        self.stats_every = int(stats_every)
+        self.admission = (cfg.serve_admission if admission is None
+                          else admission)
+        self.max_queue_requests = int(
+            cfg.serve_max_queue_rows if max_queue_requests is None
+            else max_queue_requests)
+        self._batcher = MicroBatcher(
+            1, 0.0, clock=clock, max_queue_rows=self.max_queue_requests,
+            admission=self.admission,
+            starvation_ms=float(cfg.serve_starvation_ms
+                                if starvation_ms is None
+                                else starvation_ms))
+        self.metrics = GenerationMetrics(
+            window_s=metrics_window_s, clock=clock,
+            queue_depth_fn=lambda: self._batcher.queue_depth)
+        self._decoder = GraphDecoder.for_model(model, self.slots,
+                                               self.max_seq)
+        # the ONE KV accounting (analysis.kv_memory): what lint's
+        # FF108/FF121 gates charge for this deployment is what
+        # init_cache() allocates
+        from ...analysis.kv_memory import dtype_bytes, kv_cache_bytes
+        self.kv_cache_bytes = kv_cache_bytes(
+            model.layers,
+            dict(model.mesh.sizes) if model.mesh is not None else None,
+            self.slots, self.max_seq,
+            kv_dtype_bytes=dtype_bytes(cfg.compute_dtype))
+        # dispatcher-thread-only state (single writer, no lock)
+        self._slots_state: List[Optional[_Slot]] = [None] * self.slots
+        self._caches = None
+        self._n_steps = 0
+        self._gen_faults: List[Dict] = []
+        # lifecycle (same single-use contract as ServingEngine)
+        self._thread: Optional[  # guarded_by: self._lifecycle
+            threading.Thread] = None
+        self._stopped = False    # guarded_by: self._lifecycle
+        self._draining = False   # guarded_by: self._lifecycle
+        self._finalized = False  # guarded_by: self._lifecycle
+        self._lifecycle = threading.Lock()
+        self._closing = threading.Event()
+        self._abort = threading.Event()
+        self._shutdown_done = threading.Event()
+
+    # ---- lifecycle -----------------------------------------------------
+    def _warmup(self) -> None:
+        """Compile every program the engine can dispatch BEFORE
+        serving — the generation edition of ServingEngine's bucket
+        warmup.  A prefill bucket compiled lazily mid-serving stalls
+        the whole decode batch for the compile (measured ~0.6 s/bucket
+        on CPU — every in-flight stream's TPOT eats it); paying all of
+        it at start() keeps steady-state latency flat.  The dummy
+        dispatches write into slot 0 / position 0 of the fresh cache,
+        which the first real prefill overwrites."""
+        params = self.model._params
+        tok0 = np.zeros((1, 1), np.int32)
+        for b in self._decoder.buckets:
+            fn = self._decoder.prefill_fn(b)
+            tokens = np.zeros((1, b), np.int32)
+            tokens[0, :1] = tok0[0]
+            first, self._caches = fn(params, self._caches, tokens,
+                                     np.int32(0), np.int32(1))
+        nxt, self._caches = self._decoder.decode_fn()(
+            params, self._caches, np.zeros((self.slots,), np.int32),
+            np.zeros((self.slots,), np.int32))
+        jax.device_get(nxt)
+
+    def start(self, warmup: bool = True) -> "GenerationEngine":
+        with self._lifecycle:
+            if self._stopped:
+                raise RuntimeError(
+                    "engine was stopped; create a new GenerationEngine "
+                    "(decoders cache their compiled programs on the "
+                    "model, so a fresh engine starts warm)")
+            if self._thread is None:
+                self._caches = self._decoder.init_cache()
+                if warmup:
+                    self._warmup()
+                self._gen_faults = _load_gen_faults()
+                get_logger("serve").event(
+                    "gen_engine_start", slots=self.slots,
+                    max_seq=self.max_seq,
+                    kv_cache_bytes=self.kv_cache_bytes,
+                    admission=self.admission,
+                    max_queue_requests=self.max_queue_requests)
+                self._thread = threading.Thread(
+                    target=self._decode_loop, name="ff-generate",
+                    daemon=True)
+                self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Close admissions, serve everything queued and in flight to
+        completion, stop the dispatcher, emit final stats.  Idempotent;
+        single-use (see start()).  For a BOUNDED shutdown that sheds
+        stragglers, see :meth:`drain`."""
+        with self._lifecycle:
+            self._closing.set()
+            self._batcher.close()
+            if self._thread is not None:
+                self._thread.join()
+                self._thread = None
+                if not self._finalized:
+                    self._finalized = True
+                    self.metrics.emit(extra={"final": True,
+                                             "slots": self.slots})
+            else:
+                now = self.clock()
+                err = SheddedError(
+                    "engine stopped before it was started")
+                for r in self._batcher.fail_pending():
+                    r.on_done(err, now)
+            self._stopped = True
+        self._shutdown_done.set()
+
+    def drain(self, timeout: Optional[float] = None) -> Dict:
+        """Bounded graceful shutdown: stop admitting, give in-flight
+        generation ``timeout`` seconds, then shed the stragglers
+        (queued prompts AND active streams fail with SheddedError).
+        Returns the final stats snapshot; the engine is stopped
+        afterwards."""
+        with self._lifecycle:
+            already = self._stopped or self._draining
+            thread = self._thread
+            if not already:
+                self._draining = True
+                self._closing.set()
+                self._batcher.close()
+        if already:
+            self._shutdown_done.wait()
+            return self.stats()
+        get_logger("serve").event(
+            "gen_drain", timeout_s=timeout,
+            queue_depth=self._batcher.queue_depth)
+        shed = 0
+        if thread is not None:
+            thread.join(timeout)
+            if thread.is_alive():
+                self._abort.set()
+                now = self.clock()
+                for r in self._batcher.fail_pending():
+                    if r.on_done(SheddedError(
+                            f"engine drained with work still queued "
+                            f"(drain timeout {timeout}s)"), now):
+                        shed += 1
+                thread.join(timeout)
+        else:
+            now = self.clock()
+            for r in self._batcher.fail_pending():
+                if r.on_done(SheddedError(
+                        "engine drained before it was started"), now):
+                    shed += 1
+        with self._lifecycle:
+            self._stopped = True
+            self._draining = False
+            self._thread = None
+            first = not self._finalized
+            self._finalized = True
+        snap = self.stats()
+        if first:
+            self.metrics.emit(extra={"final": True, "slots": self.slots,
+                                     "drain_shed": shed})
+        self._shutdown_done.set()
+        return snap
+
+    def __enter__(self) -> "GenerationEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ---- producer side -------------------------------------------------
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               deadline_ms: Optional[float] = None,
+               priority: int = 0) -> GenerationStream:
+        """Queue one prompt (1-D int token ids) and return its
+        :class:`GenerationStream`.  Thread-safe.
+
+        ``max_new_tokens`` caps the stream (default from config);
+        generation also ends at ``eos_id`` when the engine has one.
+        ``deadline_ms``/``priority`` behave exactly like the serving
+        engine's (PR 8): a prompt still queued at its deadline expires
+        with DeadlineExceeded before any prefill is burned; under a
+        full bounded queue the admission policy applies per request."""
+        arr = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        if arr.size < 1:
+            raise ValueError("empty prompt")
+        # None-check, not truthiness: an explicit 0 must hit the guard
+        # below, not silently fall back to the config default
+        max_new = (self.max_new_tokens if max_new_tokens is None
+                   else int(max_new_tokens))
+        if max_new < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new}")
+        if arr.size + max_new > self.max_seq:
+            raise ValueError(
+                f"prompt ({arr.size}) + max_new_tokens ({max_new}) "
+                f"exceeds the KV cache length max_seq={self.max_seq}")
+        t0 = self.clock()
+        self.metrics.record_submitted()
+        stream = GenerationStream(arr.size, max_new, t0,
+                                  deadlined=deadline_ms is not None)
+        deadline = None if deadline_ms is None else t0 + deadline_ms / 1e3
+        metrics = self.metrics
+
+        def on_done(out, now: float) -> bool:
+            # failure-path resolution only (expiry/shed/drain/stop);
+            # the success path is the decode loop's _finish
+            if isinstance(out, BaseException):
+                if stream._fail(out):
+                    metrics.record_failure(out)
+                    return True
+            return False
+
+        req = _GenRequest(stream, arr.copy(), on_done, t0,
+                          deadline=deadline, priority=priority)
+        try:
+            self._batcher.submit(req)
+        except OverloadError:
+            self.metrics.record_rejected()
+            raise
+        except RuntimeError as e:
+            self.metrics.record_rejected()
+            raise OverloadError(
+                f"engine is not admitting new work ({e})") from e
+        return stream
+
+    def stats(self) -> Dict:
+        active = sum(1 for s in self._slots_state if s is not None)
+        return {**self.metrics.snapshot(), "slots": self.slots,
+                "active_slots": active, "max_seq": self.max_seq,
+                "kv_cache_bytes": self.kv_cache_bytes,
+                "admission": self.admission,
+                "max_queue_requests": self.max_queue_requests,
+                "peak_queue_requests": self._batcher.peak_rows}
+
+    # ---- dispatcher thread ---------------------------------------------
+    def _decode_loop(self) -> None:
+        """One iteration per decode step: admit queued prompts into
+        free slots (prefill), then advance every active stream by one
+        token with ONE dispatch + ONE fetch (RL010)."""
+        while True:
+            if self._abort.is_set():
+                self._abort_active()
+                return
+            # expire queued deadlines at EVERY step boundary — with all
+            # slots busy, _admit() never polls, and a deadline must
+            # fail AT the deadline (PR 8's contract), not when a slot
+            # happens to free
+            self._batcher.reap_expired()
+            self._admit()
+            if not any(s is not None for s in self._slots_state):
+                reqs = self._batcher.next_batch(timeout=0.05)
+                if reqs:
+                    for r in reqs:
+                        self._join(r)
+                    continue
+                if (self._closing.is_set()
+                        and self._batcher.queue_depth == 0):
+                    return
+                continue
+            self._fire_slow_decode()
+            try:
+                self._decode_once()
+            except BaseException as e:  # noqa: BLE001 — one poisoned
+                # step must fail the ACTIVE streams, not kill the
+                # dispatcher; queued prompts still get served
+                self._recover_from_dispatch_error(e, "gen_decode_error")
+
+    def _admit(self) -> None:
+        """Join queued prompts into free slots at the step boundary —
+        the continuous-batching join point."""
+        for slot in range(self.slots):
+            if self._slots_state[slot] is not None:
+                continue
+            batch = self._batcher.poll()
+            if not batch:
+                return
+            for r in batch:
+                self._join(r, slot)
+
+    def _join(self, req: _GenRequest, slot: Optional[int] = None) -> None:
+        if slot is None:
+            slot = next((i for i, s in enumerate(self._slots_state)
+                         if s is None), None)
+            if slot is None:
+                # unreachable from the loop (joins only happen with a
+                # free slot), but never strand a stream if it ever is
+                req.stream._fail(SheddedError(
+                    "internal: no free decode slot at join"))
+                return
+        stream = req.stream
+        try:
+            claimed = stream.future.set_running_or_notify_cancel()
+        except RuntimeError:
+            claimed = False
+        if not claimed:
+            return  # cancelled/expired while queued
+        prompt = req.xs[0]
+        try:
+            bucket = self._decoder.prefill_bucket(prompt.size)
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :prompt.size] = prompt
+            fn = self._decoder.prefill_fn(bucket)
+            with jax.profiler.StepTraceAnnotation(
+                    "gen-prefill", step_num=self._n_steps):
+                first, self._caches = fn(
+                    self.model._params, self._caches, tokens,
+                    np.int32(slot), np.int32(prompt.size))
+                # one fetch per JOIN (not per step): the stream's first
+                # token comes out of the prefill dispatch itself
+                tok = int(jax.device_get(first))
+        except BaseException as e:  # noqa: BLE001 — a poisoned prefill
+            # fails the joining stream AND (because the dispatch may
+            # have consumed the donated cache pytree) every in-flight
+            # stream; the engine re-arms and keeps serving the queue
+            if stream._fail(e):
+                self.metrics.record_failure(e)
+            self._recover_from_dispatch_error(e, "gen_prefill_error")
+            return
+        now = self.clock()
+        st = _Slot(stream, tok, prompt.size)
+        self._slots_state[slot] = st
+        stream.ttft = now - stream.t_submit
+        stream._emit(tok)
+        self.metrics.record_ttft(stream.ttft)
+        self.metrics.record_prefill_token()
+        self._retire(slot, st, now)
+
+    def _decode_once(self) -> None:
+        """Advance the whole decode batch one position: one dispatch,
+        one token fetch, scatter to streams."""
+        tokens = np.zeros((self.slots,), np.int32)
+        pos = np.zeros((self.slots,), np.int32)
+        nactive = 0
+        for i, s in enumerate(self._slots_state):
+            if s is not None:
+                tokens[i] = s.last_token
+                pos[i] = s.length
+                nactive += 1
+        fn = self._decoder.decode_fn()
+        t0 = self.clock()
+        with jax.profiler.StepTraceAnnotation("generate",
+                                              step_num=self._n_steps):
+            nxt, self._caches = fn(self.model._params, self._caches,
+                                   tokens, pos)
+            # THE one host sync per decode step for the whole batch —
+            # per-stream tokens are scattered from it below (RL010)
+            host = np.asarray(jax.device_get(nxt))
+        now = self.clock()
+        self._n_steps += 1
+        for i, s in enumerate(self._slots_state):
+            if s is None:
+                continue
+            tok = int(host[i])
+            s.length += 1
+            s.generated += 1
+            s.last_token = tok
+            s.stream._emit(tok)
+            self._retire(i, s, now)
+        self.metrics.record_decode_step(nactive, now - t0)
+        self._fire_cancel_at_token(now)
+        if self.stats_every and self._n_steps % self.stats_every == 0:
+            self.metrics.emit(extra={"slots": self.slots,
+                                     "active": nactive,
+                                     "kv_cache_bytes":
+                                         self.kv_cache_bytes})
+
+    def _recover_from_dispatch_error(self, e: BaseException,
+                                     event: str) -> None:
+        """A failed prefill/decode dispatch raised AFTER the cache
+        pytree was donated: off-CPU the buffers are invalidated, so
+        every active stream's state is unrecoverable — fail them all,
+        reallocate the cache, and keep serving queued prompts (the
+        engine recovers; a poisoned dispatch must never wedge it on
+        'Array has been deleted' forever)."""
+        failed = 0
+        for i, s in enumerate(self._slots_state):
+            if s is None:
+                continue
+            if s.stream._fail(e):
+                self.metrics.record_failure(e)
+                failed += 1
+            self._slots_state[i] = None
+        self._caches = self._decoder.init_cache()
+        get_logger("serve").event(
+            event, error=f"{type(e).__name__}: {e}"[:300],
+            failed_streams=failed)
+
+    def _retire(self, slot: int, s: _Slot, now: float) -> None:
+        """Free the slot if its stream finished or was cancelled —
+        run at every step boundary, so a mid-generation cancel frees
+        KV capacity for the next queued prompt immediately."""
+        if s.stream.cancelled:
+            exc = GenerationCancelled(
+                f"stream cancelled after {s.generated} token(s); "
+                f"KV slot {slot} freed")
+            if s.stream._fail(exc):
+                self.metrics.record_failure(exc)
+            self._slots_state[slot] = None
+            return
+        done = s.generated >= s.stream.max_new or (
+            self.eos_id is not None and s.last_token == self.eos_id)
+        if done:
+            if s.stream._finish():
+                self.metrics.record_request(now - s.stream.t_submit,
+                                            deadlined=s.stream.deadlined)
+            self._slots_state[slot] = None
+
+    def _abort_active(self) -> None:
+        """drain(timeout) expired: shed whatever is still decoding."""
+        for i, s in enumerate(self._slots_state):
+            if s is None:
+                continue
+            exc = SheddedError(
+                "engine drained mid-generation (drain timeout)")
+            if s.stream._fail(exc):
+                self.metrics.record_failure(exc)
+            self._slots_state[i] = None
+
+    # ---- fault injection (FF_FAULT generation kinds) -------------------
+    def _fire_slow_decode(self) -> None:
+        for st in self._gen_faults:
+            if st["kind"] == "serve_slow_decode" and st["fired"] < st["n"]:
+                st["fired"] += 1
+                self._sleep(st["ms"] / 1e3)
+
+    def _fire_cancel_at_token(self, now: float) -> None:
+        for st in self._gen_faults:
+            if st["kind"] != "serve_cancel_at_token" or st["fired"]:
+                continue
+            for i, s in enumerate(self._slots_state):
+                if s is not None and s.generated >= st["n"]:
+                    st["fired"] = 1
+                    get_logger("serve").event(
+                        "gen_fault_cancel", slot=i,
+                        generated=s.generated, at_token=st["n"])
+                    s.stream.cancel()
+                    self._retire(i, s, now)
+                    break
+
+    # ---- strategy-sharded construction ---------------------------------
+    @classmethod
+    def from_strategy(cls, model, strategy_file: str, mesh=None,
+                      **kwargs) -> "GenerationEngine":
+        """Build a tensor-parallel generation engine from a searched
+        strategy ``.pb``: load the per-op ParallelConfigs, compile the
+        model against them (ffcheck-verified, mesh inferred from the
+        strategy when not given), place/re-place every parameter under
+        its strategy PartitionSpec, and shard the KV cache heads over
+        the ``c`` axis — one checkpoint, any searched sharding.
+
+        Accepts a fresh (uncompiled) model — compiled+initialized here
+        — or an already-initialized one, whose live params are gathered
+        and re-placed (the reshard pattern)."""
+        from ...strategy.proto import load_strategy_file
+        strategies = load_strategy_file(strategy_file)
+        model.config.strategies.update(strategies)
+        if not model._compiled:
+            model.compile(mesh=mesh)
+            model.init_layers(seed=model.config.seed)
+        else:
+            for op in model.layers:
+                op.parallel_config = model.config.strategies.get(
+                    op.name, op.parallel_config)
+            if mesh is not None:
+                model.mesh = mesh
+            else:
+                # the strategy names its own mesh (the same inference
+                # compile() runs): rebuild when the live one differs
+                from ...parallel.mesh import MachineMesh
+                shape = model._infer_mesh_shape()
+                if (model.mesh is None
+                        or {a: s for a, s in model.mesh.sizes.items()
+                            if s > 1} != {a: s for a, s in shape.items()
+                                          if s > 1}):
+                    model.mesh = MachineMesh(shape)
+            # re-place live params under the strategy's shardings (the
+            # partition-rule -> PartitionSpec pytree pattern); the AOT
+            # forward cache lowered for the old placement must drop —
+            # and so must any cached GraphDecoders, whose KV-cache
+            # layout was derived from the OLD mesh
+            for p in model.parameters:
+                if p.name in model._params:
+                    val = model._gather_host(model._params[p.name])
+                    model._params[p.name] = model._placed_param(p, val)
+            model._fwd_compiled.clear()
+            model.__dict__.pop("_gen_decoders", None)
+            model._build_step_fns()
+        return cls(model, **kwargs)
+
+
+def _load_gen_faults() -> List[Dict]:
+    """Materialize the FF_FAULT generation specs into per-engine firing
+    state (start() calls this once per engine)."""
+    out: List[Dict] = []
+    for spec in faults.generation_faults():
+        out.append({
+            "kind": spec.kind,
+            "n": int(spec.arg),
+            "ms": float(spec.extras.get("ms", "50")),
+            "fired": 0,
+        })
+    return out

@@ -28,7 +28,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 from ..fflogger import get_logger
 from ..profiling import quantiles
-from .errors import DeadlineExceeded, SheddedError
+from .errors import DeadlineExceeded, GenerationCancelled, SheddedError
 
 
 class ServingMetrics:
@@ -85,6 +85,7 @@ class ServingMetrics:
         self.total_rejected = 0    # guarded_by: self._lock
         self.total_shed = 0        # guarded_by: self._lock
         self.total_expired = 0     # guarded_by: self._lock
+        self.total_cancelled = 0   # guarded_by: self._lock
         self.blocked_ms_total = 0.0  # guarded_by: self._lock
 
     # hard cap on windowed admission/drop EVENTS (not requests — each
@@ -170,6 +171,12 @@ class ServingMetrics:
                 self._drop_ts.append((now, 1))
                 self._drop_n += 1
                 self._trim(now)
+            elif isinstance(exc, GenerationCancelled):
+                # a client (or the serve_cancel_at_token fault) ended
+                # the stream — NOT a dispatch failure; counting it as
+                # one would make a healthy engine whose clients cancel
+                # look like it is throwing errors
+                self.total_cancelled += 1
             else:
                 self.total_errors += 1
 
@@ -219,7 +226,8 @@ class ServingMetrics:
             totals = (self.total_dispatches, self.total_requests,
                       self.total_rows, self.total_errors,
                       self.total_rejected, self.total_shed,
-                      self.total_expired, self.blocked_ms_total)
+                      self.total_expired, self.blocked_ms_total,
+                      self.total_cancelled)
         span = self.window_s
         if disp:
             span = min(self.window_s, max(1e-6, now - disp[0][0]))
@@ -275,6 +283,7 @@ class ServingMetrics:
             "rejected": totals[4],
             "shed": totals[5],
             "expired": totals[6],
+            "cancelled": totals[8],
             "admission_blocked_ms": round(totals[7], 3),
         }
 

@@ -28,6 +28,11 @@ declare -a cases=(
   # dispatcher (docs/serving.md "Overload, SLOs & degradation";
   # in-process, injectable clock/sleep — tier-1 speed)
   "$FAST_TIMEOUT tests/test_serving.py::TestServeFaults"
+  # serve_cancel_at_token / serve_slow_decode: the token-generation
+  # fault kinds driven through the GenerationEngine's decode loop
+  # (docs/serving.md "Token generation"; a mid-generation cancel must
+  # free its KV slot and fail only its own stream)
+  "$FAST_TIMEOUT tests/test_generation.py::TestGenerationFaults"
 )
 if [ "${1:-}" != "--fast-only" ]; then
   cases+=(

@@ -67,6 +67,14 @@ by tier-1 ``tests/test_static_checks.py``).  Rules:
   run on another thread).  This is the static half of the overload
   stack's thread-safety story: the fake-clock tests exercise the
   schedules, RL009 pins the discipline.
+* **RL010 — no host syncs in the token-generation decode loop**
+  (the generation mirror of RL004/RL005, ISSUE 11): inside the decode
+  functions of ``flexflow_tpu/serving/generation/`` (``_decode_loop``
+  / ``_decode_once``), the engine's contract is ONE per-step token
+  fetch for the WHOLE decode batch — the straight-line fetch is
+  sanctioned (as is the ``while`` decode loop, the analogue of the
+  serve/epoch loops); a ``float``/``np.asarray``/``jax.device_get``
+  inside a ``for`` loop there is a per-stream sync and is rejected.
 * **RL008 — serving code reads time only through the injected clock**
   (ISSUE 8): a bare ``time.time()``/``time.monotonic()`` call inside
   ``flexflow_tpu/serving/`` bypasses the ``clock=`` every serving
@@ -125,6 +133,10 @@ _RL004_FUNCS = ("fit", "evaluate", "predict")
 # engine fetches once per packed batch in straight-line code; for-loops
 # inside these iterate requests
 _RL005_FUNCS = ("_dispatch_loop", "_dispatch_batch")
+# the token-generation decode functions RL010 scopes to (same banned
+# set): one token fetch per decode step in straight-line code;
+# for-loops inside these iterate streams/slots
+_RL010_FUNCS = ("_decode_loop", "_decode_once")
 
 # wall-clock reads RL008 bans in flexflow_tpu/serving/ (outside
 # default-argument position): every serving class takes an injectable
@@ -264,6 +276,8 @@ class _Visitor(ast.NodeVisitor):
             or relpath == "flexflow_tpu/parallel/sharding.py")
         self.in_tests = relpath.startswith("tests/")
         self.in_serving = relpath.startswith("flexflow_tpu/serving/")
+        self.in_generation = relpath.startswith(
+            "flexflow_tpu/serving/generation/")
         self.in_clock_scope = (self.in_serving
                                and relpath not in _RL008_EXEMPT)
         # RL009 engages where the concurrency-heavy classes live (the
@@ -276,6 +290,8 @@ class _Visitor(ast.NodeVisitor):
         self._batch_loops = 0                 # nested non-epoch loop depth
         self._serve_func: Optional[str] = None  # inside _dispatch_*
         self._req_loops = 0                   # nested for-loop depth there
+        self._gen_func: Optional[str] = None  # inside _decode_* (RL010)
+        self._gen_loops = 0                   # nested for-loop depth there
         self._default_pos: set = set()        # Call nodes in arg defaults
 
     def _add(self, node: ast.AST, code: str, msg: str) -> None:
@@ -350,15 +366,20 @@ class _Visitor(ast.NodeVisitor):
                     self._default_pos.add(id(sub))
         hot = (self.in_library and node.name in _RL004_FUNCS)
         serve = (self.in_serving and node.name in _RL005_FUNCS)
+        gen = (self.in_generation and node.name in _RL010_FUNCS)
         prev = (self._hot_func, self._batch_loops,
-                self._serve_func, self._req_loops)
+                self._serve_func, self._req_loops,
+                self._gen_func, self._gen_loops)
         if hot:
             self._hot_func, self._batch_loops = node.name, 0
         if serve:
             self._serve_func, self._req_loops = node.name, 0
+        if gen:
+            self._gen_func, self._gen_loops = node.name, 0
         self.generic_visit(node)
         (self._hot_func, self._batch_loops,
-         self._serve_func, self._req_loops) = prev
+         self._serve_func, self._req_loops,
+         self._gen_func, self._gen_loops) = prev
 
     visit_AsyncFunctionDef = visit_FunctionDef
 
@@ -374,6 +395,11 @@ class _Visitor(ast.NodeVisitor):
         # the epoch loop above)
         serve_scoped = (self._serve_func is not None
                         and isinstance(node, ast.For))
+        # RL010 mirrors RL005: for-loops in the decode functions
+        # iterate streams/slots; the while decode loop is the
+        # once-per-step granularity
+        gen_scoped = (self._gen_func is not None
+                      and isinstance(node, ast.For))
         # a For's iter expression runs ONCE per loop entry (e.g.
         # `for s in jax.device_get(sums):` is the once-after-the-loop
         # idiom) — scan it OUTSIDE the batch-loop scope
@@ -384,6 +410,8 @@ class _Visitor(ast.NodeVisitor):
             self._batch_loops += 1
         if serve_scoped:
             self._req_loops += 1
+        if gen_scoped:
+            self._gen_loops += 1
         # a While's test RE-EVALUATES every iteration (`while
         # float(loss) > tol:` fences per iteration) — scan it INSIDE
         if isinstance(node, ast.While):
@@ -394,6 +422,8 @@ class _Visitor(ast.NodeVisitor):
             self._batch_loops -= 1
         if serve_scoped:
             self._req_loops -= 1
+        if gen_scoped:
+            self._gen_loops -= 1
 
     visit_For = _visit_loop
     visit_While = _visit_loop
@@ -413,6 +443,13 @@ class _Visitor(ast.NodeVisitor):
                       f"loop is a per-request host sync — fetch ONCE per "
                       f"packed batch and scatter host slices "
                       f"(docs/serving.md)")
+        if self._gen_func is not None and self._gen_loops > 0:
+            self._add(node, "RL010",
+                      f"{name}() inside a {self._gen_func}() stream "
+                      f"loop is a per-stream host sync — the decode "
+                      f"loop fetches ONE token array per step for the "
+                      f"whole batch and scatters host values "
+                      f"(docs/serving.md 'Token generation')")
 
     def _check_savez(self, node: ast.Call, name: str) -> None:
         if not self.in_library or self.is_resilience:

@@ -1,0 +1,575 @@
+"""Token-generation subsystem tests (ISSUE 11, docs/serving.md "Token
+generation").
+
+The correctness anchor is the decode==forward parity suite: the
+KV-cached single-token decode must reproduce the full-sequence forward
+BIT-IDENTICALLY on CPU at every prefix length, for both the attention
+op and the LSTM cell (prefill == forward by shared code; decode by the
+q-padding / 2-step-scan kernel contracts in ops/attention.py and
+ops/rnn.py).  On top of that: the GenerationEngine's token streams must
+equal the replicated predict-style reference decode token-for-token —
+on {n:1} AND on a strategy-sharded {n:2, c:2} mesh — plus continuous
+batching, streaming, cancellation, admission reuse, KV-cache memory
+accounting and the FF_FAULT generation kinds.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import flexflow_tpu as ff
+from flexflow_tpu import faults
+from flexflow_tpu.op import OpContext
+from flexflow_tpu.ops.attention import MultiHeadAttention, PositionEmbedding
+from flexflow_tpu.ops.rnn import LSTM
+from flexflow_tpu.parallel.mesh import MachineMesh
+from flexflow_tpu.serving.errors import (DeadlineExceeded,
+                                         GenerationCancelled,
+                                         OverloadError, SheddedError)
+from flexflow_tpu.serving.generation import GenerationEngine, GraphDecoder
+from flexflow_tpu.tensor import Tensor
+
+VOCAB = 61
+SEQ = 32
+
+
+# ---------------------------------------------------------------------
+# op-level parity: decode-with-cache == full-sequence forward, bitwise
+# ---------------------------------------------------------------------
+def _op_params(op, key, offset=0):
+    params = {}
+    for i, w in enumerate(op.weights):
+        params[w.name] = w.initializer(jax.random.fold_in(key, offset + i),
+                                       w.shape, jnp.float32)
+    return params
+
+
+def _ctx():
+    return OpContext(training=False, compute_dtype="float32", mesh=None)
+
+
+def test_attention_decode_matches_forward_every_prefix():
+    """The correctness anchor: single-token decode against the KV cache
+    reproduces the causal forward's row at EVERY prefix length —
+    bit-identical on CPU (allclose elsewhere)."""
+    n, S, D, H = 2, 16, 32, 4
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((n, S, D)).astype(np.float32)
+    t_in = Tensor((n, S, D), "float32", "x")
+    op = MultiHeadAttention("attn", t_in, t_in, t_in, D, H, causal=True)
+    params = _op_params(op, jax.random.PRNGKey(0))
+    ctx = _ctx()
+
+    full = jax.jit(lambda p, x: op.forward(p, [x], ctx)[0])(params, x)
+    (pref_out,), k, v = jax.jit(
+        lambda p, x: op.forward_kv(p, [x], ctx))(params, x)
+    # prefill IS the forward (shared _qkv/_out_proj arithmetic)
+    np.testing.assert_array_equal(np.asarray(pref_out), np.asarray(full))
+
+    khost, vhost = np.asarray(k), np.asarray(v)
+    dec = jax.jit(lambda p, x1, kc, vc, pos: op.decode(p, x1, kc, vc,
+                                                       pos, ctx))
+    exact = jax.default_backend() == "cpu"
+    for t in range(S):
+        kc = np.zeros_like(khost)
+        vc = np.zeros_like(vhost)
+        kc[:, :t] = khost[:, :t]
+        vc[:, :t] = vhost[:, :t]
+        (out,), kc2, vc2 = dec(params, x[:, t:t + 1], jnp.asarray(kc),
+                               jnp.asarray(vc),
+                               jnp.full((n,), t, jnp.int32))
+        got, want = np.asarray(out)[:, 0], np.asarray(full)[:, t]
+        if exact:
+            np.testing.assert_array_equal(got, want, err_msg=f"t={t}")
+        else:
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        # the decode wrote this position's K/V — exactly the forward's
+        np.testing.assert_array_equal(np.asarray(kc2)[:, t],
+                                      khost[:, t])
+
+
+def test_lstm_decode_matches_forward_every_prefix():
+    """The RNN cell's decode (state carry in a 2-step scan — see
+    ops/rnn.py for why the scan matters) matches the scanned forward
+    bit-for-bit, both step-by-step and seeded from mid-sequence prefill
+    states."""
+    n, S, D, H = 2, 16, 24, 8
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((n, S, D)).astype(np.float32)
+    t_in = Tensor((n, S, D), "float32", "x")
+    op = LSTM("lstm", t_in, H)
+    params = _op_params(op, jax.random.PRNGKey(1))
+    ctx = _ctx()
+
+    fseq, _, _ = jax.jit(lambda p, x: op.forward(p, [x], ctx))(params, x)
+    outs, hs, cs = jax.jit(
+        lambda p, x: op.forward_states(p, [x], ctx))(params, x)
+    np.testing.assert_array_equal(np.asarray(outs[0]), np.asarray(fseq))
+
+    dec = jax.jit(lambda p, x1, h, c: op.decode(p, x1, h, c, ctx))
+    exact = jax.default_backend() == "cpu"
+    h = jnp.zeros((n, H), jnp.float32)
+    c = jnp.zeros((n, H), jnp.float32)
+    for t in range(S):
+        (o, _, _), h, c = dec(params, x[:, t:t + 1], h, c)
+        got, want = np.asarray(o)[:, 0], np.asarray(fseq)[:, t]
+        if exact:
+            np.testing.assert_array_equal(got, want, err_msg=f"t={t}")
+        else:
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # seed the carry from the prefill's mid-sequence states
+    for t0 in (5, 11):
+        (o, _, _), _, _ = dec(params, x[:, t0:t0 + 1],
+                              jnp.asarray(hs[:, t0 - 1]),
+                              jnp.asarray(cs[:, t0 - 1]))
+        if exact:
+            np.testing.assert_array_equal(np.asarray(o)[:, 0],
+                                          np.asarray(fseq)[:, t0])
+        else:
+            np.testing.assert_allclose(np.asarray(o)[:, 0],
+                                       np.asarray(fseq)[:, t0],
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_position_embedding_decode_matches_forward():
+    n, S, D = 2, 12, 16
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((n, S, D)).astype(np.float32)
+    t_in = Tensor((n, S, D), "float32", "x")
+    op = PositionEmbedding("pe", t_in)
+    params = _op_params(op, jax.random.PRNGKey(2))
+    ctx = _ctx()
+    full = jax.jit(lambda p, x: op.forward(p, [x], ctx)[0])(params, x)
+    dec = jax.jit(lambda p, x1, pos: op.decode(p, x1, pos, ctx)[0])
+    for t in range(S):
+        out = dec(params, x[:, t:t + 1], jnp.full((n,), t, jnp.int32))
+        np.testing.assert_array_equal(np.asarray(out)[:, 0],
+                                      np.asarray(full)[:, t])
+
+
+# ---------------------------------------------------------------------
+# engine-level: GenerationEngine == replicated predict-style decode
+# ---------------------------------------------------------------------
+def _build_lm(seed=0, mesh_shape=None, slots=2):
+    from flexflow_tpu.models import build_transformer_lm
+    cfg = ff.FFConfig(batch_size=4, compute_dtype="float32", seed=seed)
+    cfg.serve_gen_slots = slots
+    model = build_transformer_lm(cfg, num_layers=2, d_model=32,
+                                 num_heads=2, d_ff=64, seq_len=SEQ,
+                                 vocab_size=VOCAB)[0]
+    model.compile(ff.SGDOptimizer(lr=0.01),
+                  mesh=MachineMesh(mesh_shape or {"n": 1}))
+    model.init_layers(seed=seed)
+    return model
+
+
+def reference_decode(model, prompt, max_new, max_seq=SEQ):
+    """Replicated predict-style decode: full forward over the padded
+    prompt at every step, argmax at the last position."""
+    toks = [int(t) for t in prompt]
+    for _ in range(max_new):
+        padded = np.zeros((1, max_seq), np.int32)
+        padded[0, :len(toks)] = toks
+        probs = model.predict([padded], batch_size=2)
+        toks.append(int(np.argmax(probs[0, len(toks) - 1])))
+    return toks[len(prompt):]
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return _build_lm()
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    rng = np.random.default_rng(3)
+    return [rng.integers(1, VOCAB, int(rng.integers(2, 9)))
+            .astype(np.int32) for _ in range(6)]
+
+
+def test_engine_matches_reference_decode(lm, prompts):
+    """Acceptance pin, replicated half: engine streams == the
+    replicated predict-style reference, token for token, with tokens
+    retiring incrementally through the stream iterator."""
+    eng = GenerationEngine(lm, slots=2, max_new_tokens=6)
+    with eng:
+        streams = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        iterated = [list(s) for s in streams]      # streaming surface
+        finals = [list(int(t) for t in s.result(timeout=120))
+                  for s in streams]
+    refs = [reference_decode(lm, p, 6) for p in prompts]
+    assert finals == refs
+    assert iterated == finals  # the iterator saw exactly the tokens
+    snap = eng.stats()
+    assert snap["requests"] == len(prompts)
+    assert snap["tokens"] == 6 * len(prompts)
+    assert snap["prefills"] == len(prompts)
+    assert snap["kv_cache_bytes"] > 0
+
+
+def test_engine_eos_stops_stream(lm, prompts):
+    ref = reference_decode(lm, prompts[0], 6)
+    eos = ref[2]
+    eng = GenerationEngine(lm, slots=2, eos_id=int(eos))
+    with eng:
+        out = list(eng.submit(prompts[0], max_new_tokens=6)
+                   .result(timeout=120))
+    # stops at (and includes) the EOS token
+    assert [int(t) for t in out] == ref[:3]
+
+
+def test_continuous_batching_joins_mid_flight(lm, prompts):
+    """Iteration-level scheduling: short requests submitted AFTER a
+    long one complete while the long stream is still decoding (they
+    join freed slots at step boundaries instead of waiting for the
+    batch to drain)."""
+    eng = GenerationEngine(lm, slots=2)
+    with eng:
+        long_s = eng.submit(prompts[0], max_new_tokens=24)
+        shorts = [eng.submit(p, max_new_tokens=2) for p in prompts[1:5]]
+        for s in shorts:
+            s.result(timeout=120)
+        # 4 shorts need ~2 steps each; the long needs 23 decode steps —
+        # it cannot have finished when the last short's future resolved
+        assert not long_s.future.done()
+        out = long_s.result(timeout=120)
+    assert len(out) == 24
+    # and the shorts got the same tokens as their reference decodes
+    refs = [reference_decode(lm, p, 2) for p in prompts[1:5]]
+    assert [list(int(t) for t in s.result()) for s in shorts] == refs
+
+
+def test_cancel_mid_generation_frees_slot(lm, prompts):
+    """A mid-generation cancel fails ONLY its own stream with
+    GenerationCancelled and frees the KV slot for queued work."""
+    eng = GenerationEngine(lm, slots=2)
+    with eng:
+        victim = eng.submit(prompts[0], max_new_tokens=24)
+        other = eng.submit(prompts[1], max_new_tokens=6)
+        it = iter(victim)
+        got = [next(it), next(it)]          # let it produce a couple
+        victim.cancel()
+        with pytest.raises(GenerationCancelled):
+            victim.result(timeout=120)
+        assert len(got) == 2
+        # the other stream is unaffected ...
+        assert (list(int(t) for t in other.result(timeout=120))
+                == reference_decode(lm, prompts[1], 6))
+        # ... and the freed slot serves new work
+        late = eng.submit(prompts[2], max_new_tokens=4)
+        assert (list(int(t) for t in late.result(timeout=120))
+                == reference_decode(lm, prompts[2], 4))
+    snap = eng.stats()
+    # a client cancel is NOT a dispatch error (its own counter)
+    assert snap["cancelled"] == 1
+    assert snap["errors"] == 0
+
+
+def test_cancel_while_queued_never_prefills(lm, prompts):
+    eng = GenerationEngine(lm, slots=2)
+    # not started: everything stays queued
+    s = eng.submit(prompts[0], max_new_tokens=4)
+    s.cancel()
+    assert s.future.cancelled()
+    assert list(s) == []  # iterator terminates immediately
+    eng.stop()
+
+
+def test_queued_deadline_expires_before_prefill(lm, prompts):
+    """PR 8 semantics carried over: a prompt still queued past its
+    deadline fails with DeadlineExceeded AT a step boundary — while
+    every slot is still busy (the decode loop reaps expiry every
+    iteration; it does not wait for a slot to free) — and never burns
+    a prefill."""
+    eng = GenerationEngine(lm, slots=2)
+    with eng:
+        # occupy both slots with long generations
+        longs = [eng.submit(p, max_new_tokens=20) for p in prompts[:2]]
+        doomed = eng.submit(prompts[2], max_new_tokens=4,
+                            deadline_ms=0.001)
+        with pytest.raises(DeadlineExceeded):
+            doomed.result(timeout=10)
+        # the expiry fired while the long generations were in flight,
+        # not when a slot freed
+        assert not all(s.future.done() for s in longs)
+        for s in longs:
+            s.result(timeout=120)
+    assert eng.stats()["expired"] == 1
+
+
+def test_admission_reject_and_stop_before_start(lm, prompts):
+    """The bounded queue + reject policy apply per REQUEST, and a
+    stop() before start() fails queued streams with SheddedError."""
+    eng = GenerationEngine(lm, slots=2, max_queue_requests=2,
+                           admission="reject", max_new_tokens=4)
+    s1 = eng.submit(prompts[0])
+    s2 = eng.submit(prompts[1])
+    with pytest.raises(OverloadError):
+        eng.submit(prompts[2])
+    assert eng.stats()["rejected"] == 1
+    eng.stop()
+    for s in (s1, s2):
+        with pytest.raises(SheddedError):
+            s.result(timeout=10)
+    with pytest.raises(RuntimeError):  # single-use, like ServingEngine
+        eng.start()
+
+
+def test_submit_validation(lm):
+    eng = GenerationEngine(lm, slots=2)
+    with pytest.raises(ValueError, match="empty prompt"):
+        eng.submit(np.zeros((0,), np.int32))
+    with pytest.raises(ValueError, match="max_seq"):
+        eng.submit(np.ones((SEQ,), np.int32), max_new_tokens=4)
+    # an explicit 0 must hit the guard, not silently fall back to the
+    # config default
+    with pytest.raises(ValueError, match=">= 1"):
+        eng.submit(np.ones((4,), np.int32), max_new_tokens=0)
+    eng.stop()
+
+
+def test_lstm_lm_engine_matches_reference():
+    """The RNN-cell workload end to end: state-carry decode through the
+    engine equals the replicated reference."""
+    from flexflow_tpu.models import build_lstm_lm
+    cfg = ff.FFConfig(batch_size=4, compute_dtype="float32", seed=5)
+    model = build_lstm_lm(cfg, vocab_size=VOCAB, embed_dim=24,
+                          hidden_dim=24, num_layers=1, seq_len=SEQ)[0]
+    model.compile(ff.SGDOptimizer(lr=0.01), mesh=MachineMesh({"n": 1}))
+    model.init_layers(seed=5)
+    rng = np.random.default_rng(6)
+    prompts = [rng.integers(1, VOCAB, 4).astype(np.int32)
+               for _ in range(3)]
+    with GenerationEngine(model, slots=2, max_new_tokens=5) as eng:
+        outs = [list(int(t) for t in eng.submit(p).result(timeout=120))
+                for p in prompts]
+    assert outs == [reference_decode(model, p, 5) for p in prompts]
+
+
+def test_decoder_rejects_unsupported_graphs():
+    from flexflow_tpu.models import build_transformer
+    cfg = ff.FFConfig(batch_size=4, compute_dtype="float32")
+    clf = build_transformer(cfg, num_layers=1, d_model=32, num_heads=2,
+                            d_ff=64, seq_len=16, vocab_size=VOCAB)[0]
+    clf.compile(ff.SGDOptimizer(lr=0.01), mesh=MachineMesh({"n": 1}))
+    with pytest.raises(ValueError, match="classifier|per-token"):
+        GraphDecoder(clf, 2, 16)
+    with pytest.raises(ValueError, match="slots"):
+        GraphDecoder(clf, 1, 16)
+
+
+# ---------------------------------------------------------------------
+# strategy-sharded serving (the acceptance's {n>1} half)
+# ---------------------------------------------------------------------
+def _write_tp_strategy(path):
+    from flexflow_tpu.config import DeviceType, ParallelConfig
+    from flexflow_tpu.strategy.proto import save_strategy_file
+    strategies = {}
+    for name in ["attention_0", "attention_1", "ffn_up_0", "ffn_up_1",
+                 "ffn_down_0", "ffn_down_1", "tok_embedding"]:
+        strategies[name] = ParallelConfig(
+            device_type=DeviceType.DEVICE, dims=(2, 1, 2),
+            device_ids=tuple(range(4)))
+    save_strategy_file(str(path), strategies)
+    return strategies
+
+
+def test_sharded_engine_matches_replicated_reference(tmp_path, lm,
+                                                     prompts):
+    """Acceptance pin, sharded half: ``from_strategy`` on a searched-
+    style TP strategy ({n:2, c:2} — heads over 'c', slots over 'n')
+    produces outputs identical to the replicated predict-style decode.
+    The KV cache shards with the mesh: per-device bytes halve twice."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    pb = tmp_path / "gen_tp.pb"
+    _write_tp_strategy(pb)
+    m2 = _build_lm()  # same seed -> same init values as `lm`
+    # fresh (compiled) model: from_strategy re-places the live params
+    eng = GenerationEngine.from_strategy(m2, str(pb), slots=4,
+                                         max_new_tokens=6)
+    assert m2.mesh.axis_size("c") == 2 and m2.mesh.axis_size("n") == 2
+    with eng:
+        outs = [list(int(t) for t in
+                     eng.submit(p, max_new_tokens=6).result(timeout=180))
+                for p in prompts[:4]]
+    refs = [reference_decode(lm, p, 6) for p in prompts[:4]]
+    assert outs == refs
+    # sharded cache accounting: slots over n (x2), heads over c (x2)
+    from flexflow_tpu.analysis import kv_cache_bytes
+    rep = kv_cache_bytes(m2.layers, {"n": 1}, 4, SEQ, kv_dtype_bytes=4)
+    shd = kv_cache_bytes(m2.layers, dict(m2.mesh.sizes), 4, SEQ,
+                         kv_dtype_bytes=4)
+    assert shd == rep / 4
+    assert eng.kv_cache_bytes == shd
+
+
+def test_from_strategy_on_fresh_model(tmp_path, lm, prompts):
+    """The primary documented flow: hand ``from_strategy`` an
+    UNCOMPILED model — it compiles against the strategy (ffcheck
+    verified), infers the strategy's mesh, and inits sharded."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    from flexflow_tpu.models import build_transformer_lm
+    pb = tmp_path / "gen_tp.pb"
+    _write_tp_strategy(pb)
+    cfg = ff.FFConfig(batch_size=4, compute_dtype="float32", seed=0)
+    fresh = build_transformer_lm(cfg, num_layers=2, d_model=32,
+                                 num_heads=2, d_ff=64, seq_len=SEQ,
+                                 vocab_size=VOCAB)[0]
+    assert not fresh._compiled
+    eng = GenerationEngine.from_strategy(fresh, str(pb), slots=4,
+                                         max_new_tokens=4)
+    assert fresh._compiled and fresh.mesh.axis_size("c") == 2
+    with eng:
+        out = list(int(t) for t in
+                   eng.submit(prompts[0], max_new_tokens=4)
+                   .result(timeout=180))
+    assert out == reference_decode(lm, prompts[0], 4)
+
+
+# ---------------------------------------------------------------------
+# KV-cache memory accounting: runtime == analysis (the ONE scalar)
+# ---------------------------------------------------------------------
+def test_kv_cache_bytes_matches_real_allocation(lm):
+    from flexflow_tpu.analysis import kv_cache_bytes
+    dec = GraphDecoder.for_model(lm, 2, SEQ)
+    caches = dec.init_cache()
+    real = sum(int(leaf.nbytes) for sub in caches.values()
+               for leaf in sub.values())
+    predicted = kv_cache_bytes(lm.layers, {"n": 1}, 2, SEQ,
+                               kv_dtype_bytes=4)  # f32 compute
+    assert real == predicted
+
+
+def test_kv_bytes_flip_ff108_and_ff121(lm):
+    """The FF108 HBM gate and FF121 timeline see the engine's KV
+    scalar: a budget that fits the model alone overflows once the
+    generation deployment's cache is charged."""
+    import dataclasses
+
+    from flexflow_tpu.analysis import kv_cache_bytes, verify
+    from flexflow_tpu.config import ParallelConfig
+    from flexflow_tpu.search.cost_model import spec_for_device
+
+    strategies = {lm.layers[2].name: ParallelConfig.data_parallel(1, 3)}
+    base = verify(lm.layers, strategies, mesh_shape={"n": 1},
+                  num_devices=1, parameters=lm.parameters,
+                  spec=spec_for_device(), check_resharding=False)
+    base_codes = {d.code for d in base.errors + base.warnings}
+    # a budget just above the model's own peak
+    peak_fit = dataclasses.replace(
+        spec_for_device(), hbm_capacity=2e9)
+    kv = kv_cache_bytes(lm.layers, {"n": 1}, 4096, SEQ,
+                        kv_dtype_bytes=4)
+    rep = verify(lm.layers, strategies, mesh_shape={"n": 1},
+                 num_devices=1, parameters=lm.parameters,
+                 spec=peak_fit, check_resharding=False,
+                 extra_state_bytes=50 * kv)
+    codes = {d.code for d in rep.errors + rep.warnings}
+    assert "FF108" in codes and "FF121" in codes
+    assert "FF108" not in base_codes
+    kv_diag = next(d for d in rep.errors if d.code == "FF108")
+    assert "KV cache" in kv_diag.message
+
+
+def test_explain_reports_kv_section(lm):
+    from flexflow_tpu.analysis import explain_report
+    from flexflow_tpu.config import ParallelConfig
+    strategies = {lm.layers[2].name: ParallelConfig.data_parallel(1, 3)}
+    plain = explain_report("lm", lm.layers, strategies,
+                           mesh_shape={"n": 1})
+    rep = explain_report("lm", lm.layers, strategies,
+                         mesh_shape={"n": 1}, dtype_bytes=4,
+                         serve_slots=8, serve_seq=SEQ)
+    assert "kv_cache" in rep and rep["kv_cache"]["slots"] == 8
+    kv = rep["kv_cache"]["bytes_per_device"]
+    assert kv > 0
+    assert (rep["memory_timeline"]["state_bytes"]
+            == pytest.approx(plain["memory_timeline"]["state_bytes"]
+                             + kv))
+
+
+# ---------------------------------------------------------------------
+# FF_FAULT generation kinds (scripts/fault_matrix.sh runs this class)
+# ---------------------------------------------------------------------
+class TestGenerationFaults:
+    @pytest.fixture
+    def arm(self, monkeypatch):
+        def _arm(spec):
+            monkeypatch.setenv("FF_FAULT", spec)
+            faults.reset()
+        yield _arm
+        monkeypatch.delenv("FF_FAULT", raising=False)
+        faults.reset()
+
+    def test_parse_generation_kinds(self):
+        specs = faults.parse_faults(
+            "serve_cancel_at_token:3;serve_slow_decode:2,ms=15")
+        assert [s.kind for s in specs] == ["serve_cancel_at_token",
+                                          "serve_slow_decode"]
+        assert specs[1].extras["ms"] == "15"
+        with pytest.raises(ValueError, match="integer"):
+            faults.parse_faults("serve_cancel_at_token:soon")
+
+    def test_generation_faults_accessor(self, arm):
+        arm("serve_cancel_at_token:2;serve_slow_dispatch:1")
+        kinds = [s.kind for s in faults.generation_faults()]
+        assert kinds == ["serve_cancel_at_token"]
+        # the serving engine's accessor sees only ITS kinds
+        assert [s.kind for s in faults.serve_faults()] == \
+            ["serve_slow_dispatch"]
+
+    def test_slow_decode_uses_injected_sleep(self, arm, lm, prompts):
+        arm("serve_slow_decode:3,ms=7")
+        slept = []
+        eng = GenerationEngine(lm, slots=2, sleep=slept.append)
+        with eng:
+            out = eng.submit(prompts[0], max_new_tokens=6)\
+                .result(timeout=120)
+        assert len(out) == 6
+        assert slept == [0.007] * 3
+
+    def test_cancel_at_token_frees_slot_and_fails_only_its_stream(
+            self, arm, lm, prompts):
+        """The injected mid-generation cancel: the FIRST stream to
+        reach N tokens dies with GenerationCancelled, its KV slot
+        frees, every other stream is untouched."""
+        arm("serve_cancel_at_token:3")
+        eng = GenerationEngine(lm, slots=2)
+        with eng:
+            victim = eng.submit(prompts[0], max_new_tokens=24)
+            with pytest.raises(GenerationCancelled):
+                victim.result(timeout=120)
+            assert len(victim.tokens_so_far()) >= 3
+            # the slot freed: a full-length follow-up stream serves
+            # fine and matches the reference (fault fires once)
+            ok = eng.submit(prompts[1], max_new_tokens=6)
+            assert (list(int(t) for t in ok.result(timeout=120))
+                    == reference_decode(lm, prompts[1], 6))
+
+
+# ---------------------------------------------------------------------
+# bench harness smoke (the artifact generator)
+# ---------------------------------------------------------------------
+def test_generate_bench_smoke():
+    from flexflow_tpu.fflogger import silenced
+    from flexflow_tpu.serving.generation.bench import run_generate_bench
+    with silenced("ff", "serve"):
+        payload = run_generate_bench(
+            requests=8, slots=2, max_seq=32, prompt_lo=2, prompt_hi=6,
+            short_new=2, long_new=10, long_frac=0.25, d_model=32,
+            num_heads=2, num_layers=1, seed=0, parity_checks=1,
+            slo_sweep=False)
+    assert payload["bench"] == "serve-generate"
+    assert payload["parity"]["engine_eq_reference"]
+    assert payload["parity"]["schedulers_agree"]
+    assert payload["continuous"]["tokens"] == payload["static"]["tokens"]
+    assert payload["continuous"]["tokens_per_s"] > 0
+    assert payload["static"]["slot_efficiency"] <= 1.0
+    # PR 7/PR 9 stamping conventions on every measured row
+    for row in (payload["continuous"], payload["static"]):
+        assert "device_kind" in row and "comm_plan_digest" in row
+        assert "calibration_digest" in row

@@ -191,6 +191,43 @@ def test_static_checks_script_passes_on_repo():
      "        for r in reqs:\n"
      "            r.set_result(float(r.x))\n",
      None),
+    # RL010: a host sync inside a per-STREAM loop of the token-
+    # generation decode path fences once per stream (ISSUE 11)
+    ("flexflow_tpu/serving/generation/zz_bad_scatter.py",
+     "class E:\n"
+     "    def _decode_once(self):\n"
+     "        out = self.step()\n"
+     "        for s in self.streams:\n"
+     "            s.emit(float(out))\n",
+     "RL010"),
+    # the sanctioned shape: ONE token fetch per decode step in
+    # straight-line code, host values scattered in the loop
+    ("flexflow_tpu/serving/generation/zz_ok_scatter.py",
+     "import jax\n\n"
+     "class E:\n"
+     "    def _decode_once(self):\n"
+     "        host = jax.device_get(self.step())\n"
+     "        for i, s in enumerate(self.streams):\n"
+     "            s.emit(int(host[i]))\n",
+     None),
+    # the `while` decode loop is the per-step granularity (the RL005
+    # serve-loop analogue)
+    ("flexflow_tpu/serving/generation/zz_ok_loop.py",
+     "import jax\n\n"
+     "class E:\n"
+     "    def _decode_loop(self):\n"
+     "        while self.running:\n"
+     "            host = jax.device_get(self.step())\n"
+     "            self.publish(host)\n",
+     None),
+    # outside flexflow_tpu/serving/generation/ the rule does not
+    # engage (the PARENT serving dir is RL005's scope, not RL010's)
+    ("flexflow_tpu/serving/zz_ok_not_generation.py",
+     "class E:\n"
+     "    def _decode_once(self):\n"
+     "        for s in self.streams:\n"
+     "            s.emit(float(s.x))\n",
+     None),
     # RL006: raw jax meshes outside parallel/mesh.py bypass the
     # reshard-aware MachineMesh factory (ISSUE 6)
     ("flexflow_tpu/zz_bad_mesh.py",
