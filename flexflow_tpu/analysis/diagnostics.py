@@ -55,6 +55,10 @@ CODES: Dict[str, tuple] = {
     "FF120": (Severity.WARN, "predicted trace-time replicate fallback"),
     "FF121": (Severity.WARN,
               "liveness HBM high-water exceeds the budget"),
+    # fleet co-residency passes (ISSUE 12, serving/fleet)
+    "FF130": (Severity.ERROR,
+              "fleet co-residency: summed per-device memory exceeds HBM"),
+    "FF131": (Severity.INFO, "fleet per-model residency breakdown"),
 }
 
 

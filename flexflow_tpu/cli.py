@@ -72,16 +72,19 @@ def main(argv=None) -> None:
               "<script.py> [script args]\n"
               "       flexflow-tpu search-bench [flags]\n"
               "       flexflow-tpu train-bench [flags]\n"
-              "       flexflow-tpu serve-bench [--overload|--generate] "
-              "[flags]\n"
+              "       flexflow-tpu serve-bench [--overload|--generate|"
+              "--fleet] [flags]\n"
               "       flexflow-tpu calibrate [--out table.json | "
               "--check FILE...]\n"
               "       flexflow-tpu calibrate-bench --table table.json "
               "[--out report.json]\n"
               "       flexflow-tpu lint --model NAME [--strategy s.pb] "
               "[--devices N] [--json]\n"
+              "       flexflow-tpu lint --fleet fleet.json "
+              "[--hbm-gb G] [--json]\n"
               "       flexflow-tpu explain --model NAME [--strategy "
               "s.pb] [--mesh n=4,c=2] [--json]\n"
+              "       flexflow-tpu explain --fleet fleet.json [--json]\n"
               "flags (reference model.cc:1221-1289): -e -b --lr --wd -d "
               "--budget --alpha --reshard-budget -s/-import -ll:tpu "
               "-ll:cpu --nodes --profiling --seed --remat "
@@ -141,10 +144,18 @@ def lint_main(argv) -> int:
     parser = argparse.ArgumentParser(
         prog="flexflow-tpu lint",
         description="statically verify a strategy against a builtin "
-                    "model graph (docs/verifier.md)")
-    parser.add_argument("--model", required=True,
+                    "model graph (docs/verifier.md), or a whole model "
+                    "fleet's co-residency (--fleet, docs/serving.md "
+                    "'Model fleets')")
+    parser.add_argument("--model",
                         help=f"builtin graph: "
                              f"{', '.join(sorted(_lint_builders()))}")
+    parser.add_argument("--fleet", default="",
+                        help="fleet registry JSON: run the static "
+                             "co-residency gate over every tenant "
+                             "(summed FF108 + KV bytes vs the HBM "
+                             "budget — FF130 on overflow) instead of "
+                             "a single-model lint")
     parser.add_argument("--strategy", default="",
                         help="strategy .pb (reference wire format); "
                              "omit to lint the graph alone")
@@ -179,7 +190,13 @@ def lint_main(argv) -> int:
                              "(default: the model's sequence length)")
     args = parser.parse_args(argv)
 
+    if args.fleet:
+        return _lint_fleet(args)
     builders = _lint_builders()
+    if args.model is None:
+        print("lint: --model is required (or --fleet for the "
+              "co-residency gate)", file=sys.stderr)
+        return 2
     if args.model not in builders:
         print(f"lint: unknown model {args.model!r} (have "
               f"{', '.join(sorted(builders))})", file=sys.stderr)
@@ -265,6 +282,63 @@ def lint_main(argv) -> int:
     return 1 if report.errors else 0
 
 
+def _load_fleet_registry(path: str, what: str):
+    """Load + schema-validate a fleet registry JSON for lint/explain
+    (returns the registry or prints the problems and returns None)."""
+    import json as _json
+
+    from .serving.fleet import ModelRegistry, validate_fleet_json
+    try:
+        with open(path) as f:
+            obj = _json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"{what}: cannot load {path}: {e}", file=sys.stderr)
+        return None
+    probs = validate_fleet_json(obj)
+    if probs:
+        for p in probs:
+            print(f"{what}: {path}: {p}", file=sys.stderr)
+        return None
+    try:
+        return ModelRegistry.from_json(obj)
+    except ValueError as e:
+        print(f"{what}: {path}: {e}", file=sys.stderr)
+        return None
+
+
+def _lint_fleet(args) -> int:
+    """``flexflow-tpu lint --fleet fleet.json``: the device-free
+    co-residency gate — does the whole fleet FIT on the HBM?  Sums the
+    FF108-accounted per-device peak (+ KV caches for generation
+    tenants) across every tenant; exit 1 on FF130 (over budget), with
+    an FF131 INFO breakdown row per tenant either way."""
+    registry = _load_fleet_registry(args.fleet, "lint")
+    if registry is None:
+        return 2
+    spec = None
+    temp_factor = None
+    if args.calibration:
+        from .search.calibration import CalibrationTable, calibrated_spec
+        try:
+            table = CalibrationTable.load(args.calibration)
+        except (OSError, ValueError) as e:
+            print(f"lint: cannot load {args.calibration}: {e}",
+                  file=sys.stderr)
+            return 2
+        spec = calibrated_spec(table)
+        temp_factor = table.xla_temp_factor
+    if args.hbm_gb > 0:
+        hbm_gb = args.hbm_gb
+    else:
+        hbm_gb = registry.hbm_gb
+    from .serving.fleet import fleet_gate_report
+    report, _rows = fleet_gate_report(
+        registry, hbm_gb=hbm_gb, device_spec=spec,
+        xla_temp_factor=temp_factor)
+    print(report.render_json() if args.json else report.render_text())
+    return 1 if report.errors else 0
+
+
 def explain_main(argv) -> int:
     """``flexflow-tpu explain --model M --strategy s.pb --mesh n=16,c=4``:
     the static what-will-the-runtime-do report (docs/verifier.md
@@ -282,9 +356,14 @@ def explain_main(argv) -> int:
         prog="flexflow-tpu explain",
         description="device-free sharding / communication / memory "
                     "report for a strategy (docs/verifier.md)")
-    parser.add_argument("--model", required=True,
+    parser.add_argument("--model",
                         help=f"builtin graph: "
                              f"{', '.join(sorted(_lint_builders()))}")
+    parser.add_argument("--fleet", default="",
+                        help="fleet registry JSON: report every "
+                             "tenant's per-device residency breakdown "
+                             "(params + KV + FF108 peak) and the fleet "
+                             "total instead of a single-model report")
     parser.add_argument("--strategy", default="",
                         help="strategy .pb; omit for the default "
                              "data-parallel plan")
@@ -309,7 +388,13 @@ def explain_main(argv) -> int:
                              "(default: the model's sequence length)")
     args = parser.parse_args(argv)
 
+    if args.fleet:
+        return _explain_fleet(args)
     builders = _lint_builders()
+    if args.model is None:
+        print("explain: --model is required (or --fleet for the "
+              "residency breakdown)", file=sys.stderr)
+        return 2
     if args.model not in builders:
         print(f"explain: unknown model {args.model!r} (have "
               f"{', '.join(sorted(builders))})", file=sys.stderr)
@@ -368,6 +453,56 @@ def explain_main(argv) -> int:
         text = _json.dumps(rep, indent=2)
     else:
         text = render_explain_text(rep)
+    print(text)
+    if args.out:
+        import json as _json
+        with open(args.out, "w") as f:
+            f.write(_json.dumps(rep, indent=2) + "\n")
+        print(f"# wrote {args.out}", file=sys.stderr)
+    return 0
+
+
+def _explain_fleet(args) -> int:
+    """``flexflow-tpu explain --fleet fleet.json``: per-tenant
+    residency breakdown (params / KV / FF108 peak bytes, each tenant's
+    mesh) + the fleet total vs the HBM budget — the report half of the
+    co-residency gate (run ``lint --fleet`` for the pass/fail
+    judgement)."""
+    registry = _load_fleet_registry(args.fleet, "explain")
+    if registry is None:
+        return 2
+    from .serving.fleet import fleet_gate_report
+    from .serving.fleet.gate import resolve_budget
+    hbm_gb = args.hbm_gb or registry.hbm_gb
+    report, rows = fleet_gate_report(registry, hbm_gb=hbm_gb)
+    # the verdict IS the gate's: FF130 present <=> over budget — the
+    # report half must never re-derive (and potentially contradict)
+    # what lint --fleet gates on
+    budget = resolve_budget(hbm_gb)
+    total = sum(r["ff108_bytes"] for r in rows)
+    rep = {
+        "fleet": args.fleet,
+        "hbm_budget_gb": round(budget / 1e9, 3),
+        "total_gb": round(total / 1e9, 3),
+        "fits": not report.errors,
+        "tenants": rows,
+    }
+    if args.json:
+        import json as _json
+        text = _json.dumps(rep, indent=2)
+    else:
+        lines = [f"fleet {args.fleet}: {len(rows)} tenant(s), "
+                 f"{rep['total_gb']} GB / {rep['hbm_budget_gb']} GB "
+                 f"budget — {'FITS' if rep['fits'] else 'OVER'}"]
+        for r in rows:
+            kv = (f", kv {r['kv_bytes'] / 1e9:.3f} GB "
+                  f"({r['kv_slots']}x{r['kv_seq']})"
+                  if r["kv_bytes"] else "")
+            lines.append(
+                f"  {r['name']} [{r['engine']}] mesh {r['mesh']}: "
+                f"peak {r['ff108_bytes'] / 1e9:.3f} GB (params "
+                f"{r['params_bytes'] / 1e9:.3f} GB{kv})")
+        text = "\n".join(lines)
     print(text)
     if args.out:
         import json as _json

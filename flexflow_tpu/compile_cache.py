@@ -10,6 +10,17 @@ so CPU-mesh test entries and single-chip TPU entries coexist safely.
 Called explicitly by harnesses (bench.py, scripts/*) rather than on
 library import so embedding applications keep control of their own
 jax.config.
+
+Multi-model processes (a serving fleet — serving/fleet): XLA keys
+entries on the lowered HLO + backend/topology, so two tenants with
+IDENTICAL graphs/shapes share one on-disk entry — which is correct
+and desirable (the executable is parameter-free; params are call
+arguments).  Per-model separation of the IN-PROCESS bucket
+executables is the job of ``FFModel.forward_compiled``'s
+``(bucket, exec_digest)`` key, not this cache: model B can never be
+handed an executable lowered for model A's graph/strategies/mesh
+even when both warmed the same persistent cache
+(tests/test_fleet.py pins the collision case).
 """
 
 from __future__ import annotations

@@ -235,6 +235,13 @@ class FFConfig:
     # order, so sustained high-priority load delays low-priority work
     # but can never starve it.  0 disables aging (strict priority).
     serve_starvation_ms: float = 250.0
+    # serve_model_name: the tenant identity serving engines stamp on
+    # their serve_stats/gen_stats/serve_health events (docs/serving.md
+    # "Model fleets").  In a multi-model process (FleetEngine) every
+    # tenant gets its registry name automatically; set this for a
+    # single-engine deployment whose event stream will be merged with
+    # others' ("" = untagged single-engine default).
+    serve_model_name: str = ""
     # serve_buckets: explicit comma-separated batch buckets ("2,4,16,64");
     # empty = powers of two 2,4,...,serve_max_batch (the default omits
     # bucket 1 to keep results packing-invariant — single-row programs
@@ -354,6 +361,8 @@ class FFConfig:
                 cfg.serve_max_wait_ms = float(val())
             elif a == "--serve-buckets":
                 cfg.serve_buckets = val()
+            elif a == "--serve-model-name":
+                cfg.serve_model_name = val()
             elif a == "--serve-max-queue-rows":
                 cfg.serve_max_queue_rows = int(val())
             elif a == "--serve-admission":

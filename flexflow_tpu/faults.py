@@ -63,6 +63,21 @@ Grammar (specs joined by ``;``, qualifiers by ``,``)::
                             milliseconds (default 50) through the
                             engine's injectable sleep
 
+    model-fleet kinds (consumed by the FleetEngine — :func:`
+    fleet_faults`; docs/serving.md "Model fleets"):
+
+    fleet_load_fail:NAME    the registry build of model NAME fails
+                            (RuntimeError before compile) — a failed
+                            background load/swap must surface a
+                            fleet_load_error event and leave every
+                            serving tenant untouched.  The arg is the
+                            MODEL NAME, not a step index.
+    fleet_swap_at_dispatch:N a prepared publish (hot load/swap) is
+                            HELD until fleet dispatch index N — pins
+                            the dispatch boundary where an atomic
+                            swap lands, so swap-under-load tests are
+                            deterministic
+
     qualifiers: rank=R (fire only on rank R), attempt=A or attempt=*
                 (default attempt=0 — faults must not re-fire on the
                 restarted attempt or recovery could never be observed),
@@ -105,7 +120,8 @@ KINDS = ("kill_at_step", "hang_at_step", "corrupt_ckpt",
          "spawn_fail_attempt", "slow_rank", "grow_at_step",
          "shrink_at_step", "serve_slow_dispatch", "serve_fail_dispatch",
          "serve_queue_spike", "serve_cancel_at_token",
-         "serve_slow_decode")
+         "serve_slow_decode", "fleet_load_fail",
+         "fleet_swap_at_dispatch")
 
 SERVE_KINDS = ("serve_slow_dispatch", "serve_fail_dispatch",
                "serve_queue_spike")
@@ -114,6 +130,10 @@ SERVE_KINDS = ("serve_slow_dispatch", "serve_fail_dispatch",
 # docs/serving.md "Token generation"); disjoint from SERVE_KINDS so a
 # plan mixing both drives each engine's own fire points only
 GENERATION_KINDS = ("serve_cancel_at_token", "serve_slow_decode")
+
+# model-fleet kinds (FleetEngine / fleet registry — docs/serving.md
+# "Model fleets"); disjoint from both sets above
+FLEET_KINDS = ("fleet_load_fail", "fleet_swap_at_dispatch")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -189,6 +209,8 @@ def parse_faults(text: Optional[str]) -> List[FaultSpec]:
                 raise ValueError(
                     f"corrupt_ckpt arg must be a step number or "
                     f"'latest', got {arg!r} in {raw!r}")
+        elif kind == "fleet_load_fail":
+            pass  # the arg IS a model name — any non-empty string
         elif not (arg.isdigit() or (arg[:1] == "-" and arg[1:].isdigit())):
             raise ValueError(
                 f"{kind} arg must be an integer, got {arg!r} in {raw!r}")
@@ -361,6 +383,18 @@ def generation_faults() -> List[FaultSpec]:
     if not p:
         return []
     return [s for s in p if s.kind in GENERATION_KINDS and _matches(s)]
+
+
+def fleet_faults() -> List[FaultSpec]:
+    """The FF_FAULT model-fleet specs matching this rank/attempt, in
+    plan order (empty without a plan).  Consumers: the fleet registry's
+    build path (``fleet_load_fail``) and the FleetEngine's publish
+    boundary (``fleet_swap_at_dispatch``); this module stays jax- and
+    engine-free."""
+    p = plan()
+    if not p:
+        return []
+    return [s for s in p if s.kind in FLEET_KINDS and _matches(s)]
 
 
 def serve_faults() -> List[FaultSpec]:

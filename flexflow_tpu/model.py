@@ -126,7 +126,8 @@ class FFModel:
         # shape-bucketed AOT inference executables (forward_compiled) and
         # the per-batch-size zero label feeds they consume — both keyed
         # on batch size, both reused across predict()/serving calls
-        self._fwd_compiled: Dict[int, Any] = {}
+        self._fwd_compiled: Dict[Any, Any] = {}
+        self._exec_digest_cache: Optional[str] = None
         self._dummy_labels: Dict[int, np.ndarray] = {}
         # trace-time replicate-fallback sites drained so far (raw
         # (name, dim, degree, axis, axis_size, reason) tuples — the set
@@ -504,9 +505,11 @@ class FFModel:
 
     def _resolve_host_placements(self) -> None:
         """Host-placed parameters (reference hetero strategies: device_type
-        CPU / memory ZCM) get a pinned_host sharding; the paired device
-        sharding is used to unify memory spaces around the optimizer
-        update."""
+        CPU / memory ZCM) get a host-memory sharding (``pinned_host``
+        where the backend has it, else its feature-detected host kind —
+        :mod:`flexflow_tpu.compat`); the paired device sharding is used
+        to unify memory spaces around the optimizer update."""
+        from .compat import with_host_memory
         from .ops.linear import host_placed
         self._host_shardings: Dict[str, Any] = {}
         self._dev_shardings: Dict[str, Any] = {}
@@ -520,15 +523,15 @@ class FFModel:
                         pspec(p, op.parallel_config, self.mesh))
                 else:
                     dev = jax.sharding.SingleDeviceSharding(jax.devices()[0])
-                try:
-                    self._host_shardings[p.name] = dev.with_memory_kind(
-                        "pinned_host")
+                hs = with_host_memory(dev)
+                if hs is not None:
+                    self._host_shardings[p.name] = hs
                     self._dev_shardings[p.name] = dev
-                except Exception:
+                else:
                     import warnings
                     warnings.warn(
                         f"{p.name}: host placement requested but this "
-                        f"backend has no pinned_host memory; keeping device "
+                        f"backend has no host memory kind; keeping device "
                         f"placement")
 
     def _infer_mesh_shape(self) -> Dict[str, int]:
@@ -855,12 +858,22 @@ class FFModel:
                     g = grads.pop(_ROWS + op_name)
                     trainable.pop(_ROWS + op_name)
                     idx = batch[pos].astype(jnp.int32).reshape(-1)
-                    # negative ids: take's fill-mode VJP drops them, but
-                    # .at[] would WRAP them numpy-style and poison a
-                    # real row — push them out of range so mode="drop"
-                    # drops them too (tests pin this)
+                    # negative ids must follow the DENSE path's take-VJP
+                    # on the running jax (sparse == dense is the pin,
+                    # tests/test_sparse_embedding.py): modern jax drops
+                    # them — push them out of range so mode="drop"
+                    # drops too; legacy jax wraps them to the last row —
+                    # .at[] wraps numpy-style already, so leave them
                     nrows = params[tname].shape[0]
-                    idx = jnp.where(idx < 0, nrows, idx)
+                    from .compat import take_wraps_negative_ids
+                    if take_wraps_negative_ids():
+                        # scatter modes treat negatives as OOB even
+                        # where take wraps them — wrap explicitly so
+                        # the -1 row's gradient lands where the dense
+                        # path put it
+                        idx = jnp.where(idx < 0, idx + nrows, idx)
+                    else:
+                        idx = jnp.where(idx < 0, nrows, idx)
                     g2 = g.reshape(idx.shape[0], -1)
                     # scatter-add == plain-SGD exactly: untouched rows
                     # have zero gradient, duplicate ids accumulate.
@@ -946,8 +959,10 @@ class FFModel:
             return preds, loss_sum, sums
 
         # a re-compile invalidates any AOT bucket executables lowered
-        # from the previous _jit_forward (serving/predict re-warm lazily)
+        # from the previous _jit_forward (serving/predict re-warm
+        # lazily) AND the exec digest half of their cache key
         self._fwd_compiled = {}
+        self._exec_digest_cache = None
         donate = (0, 1)
         self._train_step = jax.jit(train_step, donate_argnums=donate)
         self._train_window = jax.jit(window_step, donate_argnums=donate)
@@ -1492,8 +1507,10 @@ class FFModel:
         # caching an executable bound to the OLD params' shardings —
         # drop any such entry now that the new params are visible (an
         # in-flight dispatch can still fail transiently; the engine
-        # fails only that batch's futures and re-lowers fresh)
+        # fails only that batch's futures and re-lowers fresh); the
+        # mesh/strategies changed, so the exec digest changes with it
         self._fwd_compiled = {}
+        self._exec_digest_cache = None
         if new_strategies is not None:
             cfg.strategies.update(new_strategies)
         cfg.mesh_shape = self._live_mesh_shape() or {"n": 1}
@@ -2119,28 +2136,66 @@ class FFModel:
             self._dummy_labels[bs] = lab
         return lab
 
+    def exec_digest(self) -> str:
+        """sha256/16 over everything a lowered forward executable
+        depends on: the op graph (names, types, output shapes/dtypes),
+        the resolved per-op strategies, the mesh factorization and the
+        compute dtype.  Part of the bucket-executable cache key
+        (:meth:`forward_compiled`), so in a multi-model process (a
+        serving fleet — serving/fleet) an executable lowered for model
+        A can never be handed to model B, and a graph/strategy change
+        that goes through compile()/reshard() misses the cache instead
+        of dispatching a stale program (tests/test_fleet.py pins the
+        two-model collision case).  Cached per compile — recomputed
+        whenever :meth:`_build_step_fns` rebuilds the programs, which
+        is also where the executable cache itself resets."""
+        cached = getattr(self, "_exec_digest_cache", None)
+        if cached is not None:
+            return cached
+        import hashlib
+        h = hashlib.sha256()
+        for op in self.layers:
+            h.update(op.name.encode())
+            h.update(str(getattr(op, "op_type", "")).encode())
+            for t in op.outputs:
+                h.update(repr((tuple(t.shape), str(t.dtype))).encode())
+            pc = op.parallel_config
+            h.update(repr(None if pc is None else
+                          (tuple(pc.dims), int(pc.device_type),
+                           tuple(pc.device_ids))).encode())
+        if self.mesh is not None:
+            h.update(repr(sorted(self.mesh.sizes.items())).encode())
+        h.update(self.config.compute_dtype.encode())
+        self._exec_digest_cache = h.hexdigest()[:16]
+        return self._exec_digest_cache
+
     def forward_compiled(self, bucket_bs: int):
         """The inference forward AOT-lowered and compiled at batch size
         ``bucket_bs`` (``jax.jit(...).lower(...).compile()``), cached
-        per bucket — compile once at startup, then every dispatch of
-        that shape reuses the executable with zero retrace/cache-lookup
-        ambiguity.  The serving engine warms one executable per shape
-        bucket this way; ``predict()`` routes through the same cache.
-        Call as ``forward_compiled(bs)(model._params, batch)`` where
-        ``batch`` is ``(*inputs, dummy_label)`` shaped ``(bs, ...)``
-        and placed like :meth:`_shard_batch` places it (params are
-        passed per call — pinned on device, never donated)."""
+        per ``(bucket, exec_digest)`` — compile once at startup, then
+        every dispatch of that shape reuses the executable with zero
+        retrace/cache-lookup ambiguity.  The digest half of the key
+        pins the executable to THIS model's graph + strategies + mesh
+        (:meth:`exec_digest`): in a fleet process the per-model caches
+        cannot cross, and a post-compile graph mutation misses instead
+        of dispatching a stale program.  The serving engine warms one
+        executable per shape bucket this way; ``predict()`` routes
+        through the same cache.  Call as
+        ``forward_compiled(bs)(model._params, batch)`` where ``batch``
+        is ``(*inputs, dummy_label)`` shaped ``(bs, ...)`` and placed
+        like :meth:`_shard_batch` places it (params are passed per
+        call — pinned on device, never donated)."""
         assert self._compiled, "call compile() first"
-        key = int(bucket_bs)
-        if key < 1:
+        if int(bucket_bs) < 1:
             raise ValueError(f"bucket batch size must be >= 1, got "
                              f"{bucket_bs}")
+        key = (int(bucket_bs), self.exec_digest())
         cached = self._fwd_compiled.get(key)
         if cached is not None:
             return cached
         specs = []
         for t in list(self.input_tensors) + [self.label_tensor]:
-            shape = (key,) + tuple(t.shape[1:])
+            shape = (int(bucket_bs),) + tuple(t.shape[1:])
             dtype = jnp.dtype(t.dtype)
             sharding = None
             if self.mesh is not None and self.mesh.is_distributed:

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec
 
+from ..compat import shard_map
 from ..initializers import GlorotUniform, ZeroInitializer
 from ..op import Op, OpContext, OpType
 from .common import cast_compute
@@ -216,12 +217,12 @@ def ring_attention(q, k, v, mesh, causal: bool, scale: float,
                  dropout_rate=dropout_rate if rng is not None else 0.0)
     if rng is None:
         wrapped = lambda q, k, v: fn(q, k, v, None)  # noqa: E731
-        return jax.shard_map(wrapped, mesh=mesh.mesh,
-                             in_specs=(spec, spec, spec), out_specs=spec,
-                             check_vma=False)(q, k, v)
-    return jax.shard_map(fn, mesh=mesh.mesh,
-                         in_specs=(spec, spec, spec, PartitionSpec()),
-                         out_specs=spec, check_vma=False)(q, k, v, rng)
+        return shard_map(wrapped, mesh.mesh,
+                         in_specs=(spec, spec, spec), out_specs=spec,
+                         check_vma=False)(q, k, v)
+    return shard_map(fn, mesh.mesh,
+                     in_specs=(spec, spec, spec, PartitionSpec()),
+                     out_specs=spec, check_vma=False)(q, k, v, rng)
 
 
 class MultiHeadAttention(Op):

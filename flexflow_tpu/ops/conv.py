@@ -476,9 +476,9 @@ class Pool2D(Op):
         strategy on THIS op falls back to the XLA lowering (returns
         None): the spec would have to all-gather real spatial shards.
         ``x`` is NHWC here."""
-        import jax as _jax
         from jax.sharding import PartitionSpec as _P
 
+        from ..compat import shard_map as _shard_map
         from .pallas_pool import pallas_max_pool_nhwc
 
         if self._spatially_split():
@@ -492,8 +492,8 @@ class Pool2D(Op):
             return pallas_max_pool_nhwc(v, self.kernel, self.stride,
                                         self.padding)
 
-        return _jax.shard_map(kern, mesh=mesh.mesh, in_specs=(spec,),
-                              out_specs=spec, check_vma=False)(x)
+        return _shard_map(kern, mesh.mesh, in_specs=(spec,),
+                          out_specs=spec, check_vma=False)(x)
 
     def parallel_dims(self):
         return (True, False, True, True)

@@ -39,9 +39,15 @@ def _host_gather(table, idx, mesh):
     zero-copy read path, embedding.cc:18-75, mapper.cc:66-71)."""
     from jax.experimental.compute_on import compute_on
 
-    hs = NamedSharding(mesh.mesh, PartitionSpec()).with_memory_kind(
-        "pinned_host")
+    from ..compat import with_host_memory
+
     ds = NamedSharding(mesh.mesh, PartitionSpec())
+    # feature-detected host memory kind (compat): backends without one
+    # fall back to the plain device gather — correctness is unchanged,
+    # only the table residency optimization is lost
+    hs = with_host_memory(ds)
+    if hs is None:
+        return jnp.take(table, idx, axis=0)
 
     @compute_on("device_host")
     @jax.jit

@@ -56,6 +56,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec
 
+from ..compat import shard_map, shard_map_partial_auto_supported
 from .mesh import MachineMesh
 
 
@@ -141,7 +142,17 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: MachineMesh,
                 f"num_stages={total_stages}, got {virtual_stages}")
         S_eff = total_stages // virtual_stages  # required pipeline width
     S = mesh.axis_size("p")
-    if S <= 1:
+    # a partial-auto shard_map (p manual, other mesh axes live — n data
+    # sharding handled by GSPMD) only compiles on the modern surface;
+    # the legacy one (compat) rejects/aborts it, so take the SAME-MATH
+    # sequential fallback there — parity with the pipelined schedule is
+    # exact by construction (the p==1 path below), only the bubble
+    # overlap is lost on that jax version
+    legacy_partial = (
+        S > 1 and not shard_map_partial_auto_supported()
+        and any(mesh.mesh.shape[a] > 1 for a in mesh.mesh.axis_names
+                if a not in mesh.subaxes("p")))
+    if S <= 1 or legacy_partial:
         # sequential fallback: same math in the schedule's traversal order
         order = traversal_order(total_stages,
                                 S_eff if schedule == "interleaved" else 1,
@@ -182,21 +193,36 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: MachineMesh,
     else:
         fn = partial(_pipeline_local, stage_fn=sfn, S=S, M=M,
                      p_axes=p_axes)
-    y, aux = jax.shard_map(
-        fn, mesh=mesh.mesh, in_specs=(pspec, x_spec),
-        out_specs=(x_spec, PartitionSpec()), check_vma=False,
-        axis_names=frozenset(p_axes))(stacked_params, x)
-    return y, aux
+    # rank identity rides in as a p-sharded operand instead of
+    # lax.axis_index: under the legacy partial-auto shard_map surface
+    # (compat) axis_index lowers to a PartitionId instruction XLA's
+    # SPMD partitioner rejects when auto axes are present; an explicit
+    # arange sharded over p gives every rank the same value portably
+    rank_ids = jnp.arange(S, dtype=jnp.int32)
+    # the aux accumulator crosses the shard_map boundary as shape (1,),
+    # not a scalar: a 0-d value carried through the inner lax.scan
+    # breaks the LEGACY shard_map's autodiff (its partial-eval gives
+    # the scalar residual a dim-0 spec and raises _SpecError on the
+    # grad path — minimal repro pinned while migrating to compat)
+    y, aux = shard_map(
+        fn, mesh.mesh,
+        in_specs=(pspec, x_spec, PartitionSpec(p_axes)),
+        out_specs=(x_spec, PartitionSpec(None)), check_vma=False,
+        axis_names=frozenset(p_axes))(stacked_params, x, rank_ids)
+    return y, aux[0]
 
 
-def _pipeline_interleaved_local(stacked_local, x_loc, *, stage_fn, S: int,
-                                M: int, v: int, p_axes, ticks: int):
+def _pipeline_interleaved_local(stacked_local, x_loc, rank_arr, *,
+                                stage_fn, S: int, M: int, v: int, p_axes,
+                                ticks: int):
     """Per-rank interleaved (virtual-stage) loop.  This rank holds v
     chunks; local chunk c is global stage ``c*S + rank``.  Each activation
     rides the full ring carrying (chunk, microbatch) tags; rank S-1 wraps
     non-final chunks back to rank 0, which otherwise injects fresh
-    microbatches.  One stage-application per rank per tick."""
-    idx = lax.axis_index(p_axes)
+    microbatches.  One stage-application per rank per tick.
+    ``rank_arr`` is this rank's (1,) slice of the p-sharded arange —
+    the portable axis_index (see pipeline_apply)."""
+    idx = rank_arr[0]
     n_loc = x_loc.shape[0]
     assert n_loc % M == 0, (n_loc, M)
     xm = x_loc.reshape((M, n_loc // M) + x_loc.shape[1:])
@@ -207,7 +233,8 @@ def _pipeline_interleaved_local(stacked_local, x_loc, *, stage_fn, S: int,
     mb0 = jnp.asarray(0, jnp.int32)
     inj0 = jnp.asarray(0, jnp.int32)    # next microbatch to inject (rank 0)
     out0 = jnp.zeros_like(xm)
-    aux0 = jnp.float32(0.0)
+    # (1,)-shaped, never 0-d: see pipeline_apply's out_specs note
+    aux0 = jnp.zeros((1,), jnp.float32)
 
     def tick(carry, _):
         x_arr, tag, mb, inj, out, aux = carry
@@ -250,12 +277,13 @@ def _pipeline_interleaved_local(stacked_local, x_loc, *, stage_fn, S: int,
     return out.reshape(x_loc.shape), aux
 
 
-def _pipeline_local(stacked_local, x_loc, *, stage_fn, S: int, M: int,
-                    p_axes):
+def _pipeline_local(stacked_local, x_loc, rank_arr, *, stage_fn, S: int,
+                    M: int, p_axes):
     """Per-device GPipe loop (runs inside shard_map).  Each rank holds a
     contiguous GROUP of stages (total_stages / S per rank, often 1) and
-    applies them in order within its tick."""
-    idx = lax.axis_index(p_axes)
+    applies them in order within its tick.  ``rank_arr`` is this rank's
+    (1,) slice of the p-sharded arange (portable axis_index)."""
+    idx = rank_arr[0]
     n_loc = x_loc.shape[0]
     assert n_loc % M == 0, (n_loc, M)
     xm = x_loc.reshape((M, n_loc // M) + x_loc.shape[1:])
@@ -289,8 +317,10 @@ def _pipeline_local(stacked_local, x_loc, *, stage_fn, S: int, M: int,
         state = lax.ppermute(y, p_axes, perm)
         return (state, out, aux), None
 
-    (state, out, aux), _ = lax.scan(tick, (state0, out0, jnp.float32(0.0)),
-                                    jnp.arange(S + M - 1))
+    # (1,)-shaped aux carry, never 0-d: see pipeline_apply's note
+    (state, out, aux), _ = lax.scan(
+        tick, (state0, out0, jnp.zeros((1,), jnp.float32)),
+        jnp.arange(S + M - 1))
     # only the last rank holds real outputs; broadcast around the ring
     out = lax.psum(jnp.where(idx == S - 1, out, jnp.zeros_like(out)), p_axes)
     # /M rescales per-microbatch aux to full-batch scale (exact only for

@@ -826,12 +826,18 @@ def harvest_train_dispatch(table: CalibrationTable, name: str, model,
     return mean_ms
 
 
-def harvest_serve_dispatch(table: CalibrationTable, name: str,
+def harvest_serve_dispatch(table: CalibrationTable, name: Optional[str],
                            snapshot: Dict) -> int:
     """Harvest the serving engine's per-shape-bucket dispatch medians
     (the ``per_bucket`` section ``ServingMetrics.snapshot`` reports)
-    into ``table.dispatch["serve|<name>|bucket<b>"]`` entries.  Returns
-    the number of buckets recorded."""
+    into ``table.dispatch["serve|<name>|bucket<b>"]`` entries.
+    ``name=None`` keys on the snapshot's own ``model`` tag — the
+    per-engine identity every serve_stats row now carries, so a fleet
+    process harvesting N co-resident engines' snapshots can never
+    attribute model B's dispatch times to model A.  Returns the number
+    of buckets recorded."""
+    if name is None:
+        name = snapshot.get("model") or "default"
     per_bucket = snapshot.get("per_bucket") or {}
     n = 0
     for bucket, rec in sorted(per_bucket.items()):

@@ -9,11 +9,13 @@ from .batcher import (ADMISSION_POLICIES, MicroBatcher, Request, bucket_for,
 from .engine import HEALTH_STATES, ServingEngine
 from .errors import (DeadlineExceeded, GenerationCancelled, OverloadError,
                      ServingError, SheddedError)
+from .fleet import FleetEngine, ModelRegistry, TenantSpec
 from .generation import GenerationEngine, GenerationStream
 from .metrics import ServingMetrics
 
 __all__ = ["ServingEngine", "MicroBatcher", "Request", "ServingMetrics",
            "ServingError", "OverloadError", "SheddedError",
            "DeadlineExceeded", "GenerationCancelled", "GenerationEngine",
-           "GenerationStream", "ADMISSION_POLICIES", "HEALTH_STATES",
+           "GenerationStream", "FleetEngine", "ModelRegistry",
+           "TenantSpec", "ADMISSION_POLICIES", "HEALTH_STATES",
            "bucket_for", "derive_buckets", "split_sizes"]

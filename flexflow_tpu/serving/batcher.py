@@ -336,6 +336,32 @@ class MicroBatcher:
             self._closed = True
             self._cv.notify_all()
 
+    def requeue(self, reqs: Sequence[Request]) -> None:
+        """Transfer already-admitted requests INTO this batcher,
+        bypassing admission: the hot-swap path moves the outgoing
+        engine's pending queue to its replacement at the publish
+        boundary (serving/fleet), and work that was admitted once must
+        not be re-judged — re-rejecting it would turn a zero-loss swap
+        into shed requests.  Order: requeued requests keep their
+        original submit times, and within a priority class they land
+        ahead of anything the new engine queued meanwhile only if
+        requeued first (the fleet publishes before re-opening
+        admission, so in practice they do)."""
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            was_empty = self._count == 0
+            for req in reqs:
+                self._classes.setdefault(req.priority,
+                                         deque()).append(req)
+                self._rows += req.n
+                self._count += 1
+                if req._watched:
+                    self._watch += 1
+            self._peak_rows = max(self._peak_rows, self._rows)
+            if reqs and (was_empty or self._rows >= self.max_batch):
+                self._cv.notify_all()
+
     def fail_pending(self) -> List[Request]:
         """Atomically remove EVERYTHING still queued and hand it to the
         caller (drain-timeout stragglers: the engine fails their

@@ -482,6 +482,12 @@ def main(argv=None) -> None:
         from .generation.bench import main as gen_main
         gen_main([a for a in argv if a != "--generate"])
         return
+    if "--fleet" in argv:
+        # multi-tenant isolation + hot-swap benchmark
+        # (docs/serving.md "Model fleets")
+        from .fleet.bench import main as fleet_main
+        fleet_main([a for a in argv if a != "--fleet"])
+        return
     ap = argparse.ArgumentParser(
         prog="flexflow-tpu serve-bench",
         description="serving-engine microbenchmark: shape-bucketed AOT "

@@ -153,7 +153,8 @@ class ServingEngine:
                  degraded_after_errors: int = 2,
                  degraded_drop_frac: float = 0.5,
                  clock: Callable[[], float] = time.monotonic,
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Callable[[float], None] = time.sleep,
+                 name: str = ""):
         assert model._compiled, "compile() + init_layers() the model first"
         # persistent compile cache: bucket warmup below is exactly the
         # compile-once-at-startup cost the cache makes warm across
@@ -184,9 +185,16 @@ class ServingEngine:
             max_queue_rows=self.max_queue_rows, admission=self.admission,
             starvation_ms=float(cfg.serve_starvation_ms
                                 if starvation_ms is None else starvation_ms))
+        # tenant identity: stamped on serve_stats/serve_health/
+        # serve_dispatch_error events so N co-resident engines emit
+        # distinguishable streams (FleetEngine passes the registry
+        # name; "" = untagged single-engine default, overridable via
+        # FFConfig.serve_model_name / --serve-model-name)
+        self.name = str(name or cfg.serve_model_name)
         self.metrics = ServingMetrics(
             window_s=metrics_window_s, clock=clock,
-            queue_depth_fn=lambda: self._batcher.queue_depth)
+            queue_depth_fn=lambda: self._batcher.queue_depth,
+            model=self.name)
         self._n_inputs = len(model.input_tensors)
         self._in_dtypes = [t.dtype for t in model.input_tensors]
         self._in_shapes = [tuple(t.shape[1:]) for t in model.input_tensors]
@@ -205,6 +213,9 @@ class ServingEngine:
         # are the one documented exception
         self._thread: Optional[  # guarded_by: self._lifecycle
             threading.Thread] = None
+        # fleet mode: a FleetEngine drives dispatch_pending() instead
+        # of this engine owning a thread (serving/fleet)
+        self._external = False   # guarded_by: self._lifecycle
         self._n_dispatch = 0  # dispatcher-thread-only (single writer)
         self._stopped = False    # guarded_by: self._lifecycle
         self._draining = False   # guarded_by: self._lifecycle
@@ -234,7 +245,8 @@ class ServingEngine:
             return "stopped"
         if self._draining:     # unguarded-ok: lock-free health read
             return "draining"
-        if self._thread is None:  # unguarded-ok: lock-free health read
+        if (self._thread is None  # unguarded-ok: lock-free health read
+                and not self._external):  # unguarded-ok: lock-free read
             return "starting"
         if self._consec_errors >= self._degraded_after_errors:
             return "degraded"
@@ -263,7 +275,7 @@ class ServingEngine:
             self._last_health = state
             rate, submitted = self.metrics.drop_stats()
             get_logger("serve").event(
-                "serve_health", prev=prev, state=state,
+                "serve_health", model=self.name, prev=prev, state=state,
                 consec_errors=self._consec_errors,
                 drop_rate=round(rate, 4), window_submitted=submitted,
                 queue_depth=self._batcher.queue_depth)
@@ -313,15 +325,18 @@ class ServingEngine:
                                              "max_batch": self.max_batch,
                                              "health": "stopped"})
             else:
-                # never started: there is no dispatcher to drain the
-                # queue, so fail any futures queued before stop() —
-                # leaving them pending would block result() forever.
-                # SheddedError, like drain()'s stragglers: a shutdown
-                # eviction is load management, and the typed contract
-                # (`except ServingError`) must cover it
+                # no dispatcher thread (never started, or fleet-managed):
+                # nothing will drain the queue, so fail any futures
+                # still queued — leaving them pending would block
+                # result() forever.  SheddedError, like drain()'s
+                # stragglers: a shutdown eviction is load management,
+                # and the typed contract (`except ServingError`) must
+                # cover it
                 now = self.clock()
                 err = SheddedError(
-                    "engine stopped before it was started")
+                    "engine stopped with work still queued (fleet "
+                    "unload)" if self._external
+                    else "engine stopped before it was started")
                 while True:
                     reqs = self._batcher.poll()
                     if not reqs:
@@ -356,7 +371,7 @@ class ServingEngine:
             return self.stats()
         self._health_tick()
         get_logger("serve").event(
-            "serve_drain", timeout_s=timeout,
+            "serve_drain", model=self.name, timeout_s=timeout,
             queue_depth=self._batcher.queue_depth,
             pending_rows=self._batcher.pending_rows)
         shed = 0
@@ -382,6 +397,7 @@ class ServingEngine:
                 if thread.is_alive():
                     get_logger("serve").event(
                         "serve_drain_abandoned",
+                        model=self.name,
                         timeout_s=timeout,
                         note="dispatcher wedged in an in-flight "
                              "dispatch; daemon thread abandoned")
@@ -613,34 +629,79 @@ class ServingEngine:
                 return  # batcher closed mid-spike: drain wins
             left -= sz
 
+    # ---- fleet-managed (external) dispatch -----------------------------
+    def begin_external_dispatch(self) -> "ServingEngine":
+        """Fleet mode: mark the engine live WITHOUT its own dispatcher
+        thread — a :class:`~flexflow_tpu.serving.fleet.FleetEngine`
+        drives :meth:`dispatch_pending` from ONE shared dispatcher,
+        interleaving this engine's packed batches with its co-resident
+        tenants' under weighted-fair scheduling.  The producer side
+        (submit, admission, deadlines, priorities) behaves exactly as
+        under :meth:`start`."""
+        with self._lifecycle:
+            if self._stopped:
+                raise RuntimeError(
+                    "engine was stopped; create a new ServingEngine")
+            if self._thread is not None:
+                raise RuntimeError(
+                    "engine already runs its own dispatcher thread")
+            self._serve_faults = _load_serve_faults()
+            self._external = True
+        self._health_tick()
+        return self
+
+    @property
+    def has_pending(self) -> bool:
+        """Whether the engine has queued work an external dispatcher
+        should schedule (fleet mode)."""
+        return self._batcher.queue_depth > 0
+
+    def dispatch_pending(self) -> Optional[float]:
+        """Externally-driven dispatch step (fleet mode): pop ONE due
+        coalesced batch (non-blocking) and dispatch it.  Returns the
+        wall seconds the dispatch+fetch took — the device-time the
+        fleet's fair scheduler charges this tenant — or None when
+        nothing was due.  Error containment matches the owned
+        dispatcher thread: a poisoned batch fails only its own futures
+        and the time spent is still charged."""
+        reqs = self._batcher.poll()
+        if not reqs:
+            return None
+        t0 = self.clock()
+        self._dispatch_guarded(reqs)
+        return max(0.0, self.clock() - t0)
+
     # ---- dispatcher thread ---------------------------------------------
     def _dispatch_loop(self) -> None:
         while True:
             reqs = self._batcher.next_batch()
             if reqs is None:
                 return  # closed and drained
-            try:
-                self._fire_serve_faults()
-                self._dispatch_batch(reqs)
-            except BaseException as e:  # noqa: BLE001 — one poisoned
-                # batch must fail ITS futures, not kill the dispatcher:
-                # the engine keeps serving subsequent batches.  on_done
-                # reports whether it completed the LOGICAL request (and
-                # records the failure class), so split chunks count
-                # their request once — the same population serve_stats'
-                # ``errors`` counter reports.
-                self._consec_errors += 1
-                now = self.clock()
-                failed = sum(1 for r in reqs if r.on_done(e, now))
-                # one structured line per failed dispatch: a failure
-                # storm must be visible in the event stream, not only
-                # as a counter clients discover via exceptions
-                get_logger("serve").event(
-                    "serve_dispatch_error",
-                    error=f"{type(e).__name__}: {e}"[:300],
-                    failed_requests=failed,
-                    errors_total=self.metrics.total_errors)
-                self._health_tick()
+            self._dispatch_guarded(reqs)
+
+    def _dispatch_guarded(self, reqs) -> None:
+        try:
+            self._fire_serve_faults()
+            self._dispatch_batch(reqs)
+        except BaseException as e:  # noqa: BLE001 — one poisoned
+            # batch must fail ITS futures, not kill the dispatcher:
+            # the engine keeps serving subsequent batches.  on_done
+            # reports whether it completed the LOGICAL request (and
+            # records the failure class), so split chunks count
+            # their request once — the same population serve_stats'
+            # ``errors`` counter reports.
+            self._consec_errors += 1
+            now = self.clock()
+            failed = sum(1 for r in reqs if r.on_done(e, now))
+            # one structured line per failed dispatch: a failure
+            # storm must be visible in the event stream, not only
+            # as a counter clients discover via exceptions
+            get_logger("serve").event(
+                "serve_dispatch_error", model=self.name,
+                error=f"{type(e).__name__}: {e}"[:300],
+                failed_requests=failed,
+                errors_total=self.metrics.total_errors)
+            self._health_tick()
 
     def _dispatch_batch(self, reqs) -> None:
         import jax

@@ -1,0 +1,22 @@
+"""flexflow_tpu.serving.fleet — multi-tenant serving: N models, one
+mesh (docs/serving.md "Model fleets").
+
+* :class:`ModelRegistry` / :class:`TenantSpec` — name → checkpoint +
+  searched strategy + engine kind + fairness/admission knobs
+  (JSON file or programmatic);
+* :class:`FleetEngine` — one dispatcher multiplexing every resident
+  engine under weighted-fair device-time scheduling, with hot
+  load/unload/swap at dispatch boundaries;
+* :func:`fleet_gate_report` — the device-free co-residency gate
+  (``flexflow-tpu lint --fleet``): does the fleet FIT on the HBM?
+"""
+
+from .engine import FleetEngine
+from .gate import fleet_gate_report, model_residency, static_params_bytes
+from .registry import (ENGINE_KINDS, ModelRegistry, TenantSpec,
+                       build_model, builtin_builders, validate_fleet_json)
+
+__all__ = ["FleetEngine", "ModelRegistry", "TenantSpec",
+           "fleet_gate_report", "model_residency", "static_params_bytes",
+           "validate_fleet_json", "builtin_builders", "build_model",
+           "ENGINE_KINDS"]

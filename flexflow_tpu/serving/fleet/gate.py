@@ -1,0 +1,191 @@
+"""Static co-residency gate — do N models FIT on one mesh?
+(docs/serving.md "Model fleets"; ``flexflow-tpu lint --fleet`` /
+``explain --fleet``.)
+
+Entirely device-free: per tenant it builds the registry's UNCOMPILED
+graph, resolves its strategy, and computes
+
+* ``ff108_bytes`` — the per-device peak through the SAME accounting the
+  single-model FF108 gate and the search's legality check use
+  (``Simulator.peak_memory_bytes`` x the compiler-temp factor, with
+  ``opt_slot_bytes=0``: a serving tenant holds no optimizer state),
+  plus the KV cache for generation tenants;
+* ``resident_bytes`` — the always-resident part alone: per-device
+  parameter bytes placed by THE tracer's own ``param_spec`` (over the
+  device-free AbstractMesh — the PR 9 shared-placement guarantee) plus
+  ``analysis.kv_memory.kv_cache_bytes``.  This number is pinned
+  byte-for-byte against the engine's real allocations
+  (``FleetEngine.stats()[..]["resident_bytes"]``,
+  tests/test_fleet.py) — the gate and the runtime cannot disagree.
+
+The fleet verdict sums ``ff108_bytes`` across tenants: over the HBM
+budget → **FF130** (ERROR — lint exits 1); each tenant contributes an
+**FF131** INFO breakdown row either way.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ...analysis.diagnostics import DiagnosticReport, make
+from ...analysis.kv_memory import (default_serve_seq, dtype_bytes,
+                                   kv_cache_bytes)
+from ...analysis.strategy_passes import infer_mesh_shape
+from ...parallel.mesh import AbstractMesh
+from .registry import ModelRegistry, TenantSpec
+
+# parameters are held in the f32 master dtype (FFConfig.param_dtype)
+PARAM_BYTES = 4
+
+
+def _subaxis_sizes(mesh: AbstractMesh) -> Dict[str, int]:
+    """size of every axis name a PartitionSpec entry can mention:
+    canonical axes ("n") and their prime sub-axes ("n0", "n1", ...)."""
+    out: Dict[str, int] = {}
+    for a, size in mesh.sizes.items():
+        out[a] = size
+        for nm, f in zip(mesh.subaxes(a), mesh._subfactors[a]):
+            out[nm] = f
+    return out
+
+
+def static_params_bytes(layers, strategies, mesh: AbstractMesh) -> float:
+    """Per-device parameter bytes under the strategy — placed by the
+    SAME ``param_spec`` the tracer uses (on the AbstractMesh), so the
+    static number equals what ``init_layers`` actually allocates per
+    device."""
+    from ...parallel.sharding import param_spec
+    sizes = _subaxis_sizes(mesh)
+    total = 0.0
+    for op in layers:
+        pc = (strategies or {}).get(op.name)
+        for w in op.weights:
+            spec = param_spec(w, pc, mesh, on_fallback=lambda *a: None)
+            parts = 1
+            for entry in spec:
+                if entry is None:
+                    continue
+                names = ((entry,) if isinstance(entry, str)
+                         else tuple(entry))
+                for nm in names:
+                    parts *= sizes.get(nm, 1)
+            vol = 1
+            for s in w.shape:
+                vol *= int(s)
+            total += vol * PARAM_BYTES / parts
+    return total
+
+
+def model_residency(spec: TenantSpec, layers, input_tensors, strategies,
+                    mesh_shape: Optional[Dict[str, int]] = None,
+                    device_spec=None,
+                    xla_temp_factor: Optional[float] = None,
+                    compute_dtype: str = "float32") -> Dict:
+    """One tenant's per-device memory prediction (see module
+    docstring).  ``mesh_shape`` defaults to the strategy-inferred mesh
+    (exactly like ``lint``)."""
+    from ...search.cost_model import XLA_TEMP_FACTOR, spec_for_device
+    from ...search.simulator import Simulator
+
+    device_spec = device_spec or spec_for_device()
+    factor = (float(xla_temp_factor) if xla_temp_factor
+              else XLA_TEMP_FACTOR)
+    if mesh_shape is None:
+        if strategies:
+            mesh_shape, _ = infer_mesh_shape(strategies, layers, 10 ** 9)
+        else:
+            # no strategy: the tenant serves replicated — every device
+            # holds the full model, so the per-device view is {n: 1}
+            mesh_shape = {"n": 1}
+    mesh = AbstractMesh(mesh_shape)
+    kv = 0.0
+    slots = seq = 0
+    if spec.engine == "generation":
+        slots = int(spec.generation.get("slots", 8))
+        seq = (int(spec.generation.get("max_seq", 0))
+               or default_serve_seq(input_tensors) or 0)
+        if slots > 0 and seq > 0:
+            kv = kv_cache_bytes(layers, mesh_shape, slots, seq,
+                                kv_dtype_bytes=dtype_bytes(compute_dtype))
+    sim = Simulator(spec=device_spec,
+                    num_devices=max(1, mesh.mesh_product),
+                    use_native=False, opt_slot_bytes=0)
+    peak = sim.peak_memory_bytes(layers, strategies or {}, mesh_shape,
+                                 assume_remat=False) * factor
+    params = static_params_bytes(layers, strategies, mesh)
+    return {
+        "name": spec.name,
+        "engine": spec.engine,
+        "mesh": {a: s for a, s in mesh_shape.items() if s > 1} or {"n": 1},
+        "params_bytes": params,
+        "kv_bytes": kv,
+        "kv_slots": slots,
+        "kv_seq": seq,
+        # the byte-for-byte pin vs the engine's real allocation
+        "resident_bytes": params + kv,
+        # the gate quantity: FF108 accounting + the unscaled KV scalar
+        # (a preallocated buffer has no XLA temps — same rule as the
+        # single-model lint --serve-slots path)
+        "ff108_bytes": peak + kv,
+    }
+
+
+def resolve_budget(hbm_gb: float, device_spec=None) -> float:
+    """The per-device HBM budget in bytes: an explicit ``hbm_gb``
+    override, else the device spec's capacity — the ONE resolution
+    rule shared by the FF130 gate and ``explain --fleet``'s verdict
+    (they must never disagree on the same registry)."""
+    from ...search.cost_model import spec_for_device
+    device_spec = device_spec or spec_for_device()
+    return hbm_gb * 1e9 if hbm_gb > 0 else device_spec.hbm_capacity
+
+
+def fleet_gate_report(registry: ModelRegistry,
+                      hbm_gb: float = 0.0,
+                      device_spec=None,
+                      xla_temp_factor: Optional[float] = None
+                      ) -> Tuple[DiagnosticReport, List[Dict]]:
+    """The co-residency verdict for a whole registry: per-tenant
+    residency rows (FF131 INFO) and the summed-vs-HBM gate (FF130
+    ERROR when the fleet does not fit).  ``hbm_gb`` overrides the
+    device spec's HBM capacity (the registry file's ``hbm_gb`` is the
+    caller's usual source)."""
+    from ...search.cost_model import spec_for_device
+
+    device_spec = device_spec or spec_for_device()
+    hbm = resolve_budget(hbm_gb, device_spec)
+    report = DiagnosticReport()
+    rows: List[Dict] = []
+    total = 0.0
+    for name in registry.names():
+        spec = registry.spec(name)
+        model, strategies = registry.graph(name)
+        row = model_residency(spec, model.layers, model.input_tensors,
+                              strategies, device_spec=device_spec,
+                              xla_temp_factor=xla_temp_factor)
+        rows.append(row)
+        total += row["ff108_bytes"]
+        kv_note = (f" + {row['kv_bytes'] / 1e9:.2f} GB KV "
+                   f"({row['kv_slots']} slots x {row['kv_seq']})"
+                   if row["kv_bytes"] else "")
+        report.add(make(
+            "FF131", name,
+            f"[{row['engine']}] mesh {row['mesh']}: "
+            f"{row['ff108_bytes'] / 1e9:.2f} GB peak "
+            f"({row['params_bytes'] / 1e9:.2f} GB params{kv_note})"))
+    if total > hbm:
+        worst = max(rows, key=lambda r: r["ff108_bytes"])
+        report.add(make(
+            "FF130", "",
+            f"fleet of {len(rows)} model(s) needs "
+            f"{total / 1e9:.2f} GB per device, budget is "
+            f"{hbm / 1e9:.2f} GB; largest tenant: {worst['name']} "
+            f"({worst['ff108_bytes'] / 1e9:.2f} GB)",
+            hint="unload a tenant, shard the largest one wider, or "
+                 "serve on more HBM — the same fleet minus one model "
+                 "may already pass"))
+    return report, rows
+
+
+__all__ = ["fleet_gate_report", "model_residency", "resolve_budget",
+           "static_params_bytes"]

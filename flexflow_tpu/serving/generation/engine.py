@@ -294,7 +294,8 @@ class GenerationEngine:
                  admission: Optional[str] = None,
                  starvation_ms: Optional[float] = None,
                  stats_every: int = 32, metrics_window_s: float = 30.0,
-                 clock=time.monotonic, sleep=time.sleep):
+                 clock=time.monotonic, sleep=time.sleep,
+                 name: str = ""):
         assert model._compiled, "compile() + init_layers() the model first"
         _enable_compile_cache()
         cfg = model.config
@@ -320,9 +321,14 @@ class GenerationEngine:
             starvation_ms=float(cfg.serve_starvation_ms
                                 if starvation_ms is None
                                 else starvation_ms))
+        # tenant identity, stamped on gen_stats/gen_* events (fleet
+        # co-residency: N engines in one process stay distinguishable;
+        # FFConfig.serve_model_name is the single-engine default)
+        self.name = str(name or cfg.serve_model_name)
         self.metrics = GenerationMetrics(
             window_s=metrics_window_s, clock=clock,
-            queue_depth_fn=lambda: self._batcher.queue_depth)
+            queue_depth_fn=lambda: self._batcher.queue_depth,
+            model=self.name)
         self._decoder = GraphDecoder.for_model(model, self.slots,
                                                self.max_seq)
         # the ONE KV accounting (analysis.kv_memory): what lint's
@@ -386,7 +392,7 @@ class GenerationEngine:
                     self._warmup()
                 self._gen_faults = _load_gen_faults()
                 get_logger("serve").event(
-                    "gen_engine_start", slots=self.slots,
+                    "gen_engine_start", model=self.name, slots=self.slots,
                     max_seq=self.max_seq,
                     kv_cache_bytes=self.kv_cache_bytes,
                     admission=self.admission,
@@ -438,7 +444,7 @@ class GenerationEngine:
             self._shutdown_done.wait()
             return self.stats()
         get_logger("serve").event(
-            "gen_drain", timeout_s=timeout,
+            "gen_drain", model=self.name, timeout_s=timeout,
             queue_depth=self._batcher.queue_depth)
         shed = 0
         if thread is not None:
@@ -476,6 +482,66 @@ class GenerationEngine:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+    # ---- fleet-managed (external) dispatch -----------------------------
+    def begin_external_dispatch(self, warmup: bool = True
+                                ) -> "GenerationEngine":
+        """Fleet mode: ready the engine WITHOUT its own decode thread —
+        a :class:`~flexflow_tpu.serving.fleet.FleetEngine` drives
+        :meth:`dispatch_pending` decode steps from ONE shared
+        dispatcher, interleaved with its co-resident tenants' dense
+        dispatches under weighted-fair scheduling.  The producer side
+        (submit, admission, deadlines) behaves exactly as under
+        :meth:`start`."""
+        with self._lifecycle:
+            if self._stopped:
+                raise RuntimeError(
+                    "engine was stopped; create a new GenerationEngine")
+            if self._thread is not None:
+                raise RuntimeError(
+                    "engine already runs its own decode thread")
+            if self._caches is None:
+                self._caches = self._decoder.init_cache()
+                if warmup:
+                    self._warmup()
+                self._gen_faults = _load_gen_faults()
+                get_logger("serve").event(
+                    "gen_engine_start", model=self.name, slots=self.slots,
+                    max_seq=self.max_seq,
+                    kv_cache_bytes=self.kv_cache_bytes,
+                    admission=self.admission,
+                    max_queue_requests=self.max_queue_requests,
+                    external=True)
+        return self
+
+    def dispatch_pending(self) -> Optional[float]:
+        """Externally-driven decode step (fleet mode): expire queued
+        deadlines, join queued prompts into free slots (prefill), and
+        advance every active stream one token.  Returns the wall
+        seconds spent — the device-time the fleet's fair scheduler
+        charges this tenant — or None when nothing was due.  Error
+        containment matches the owned decode loop (a poisoned step
+        fails the active streams, the engine keeps serving)."""
+        t0 = self.clock()
+        self._batcher.reap_expired()
+        self._admit()
+        if not any(s is not None for s in self._slots_state):
+            return None  # no active streams, nothing queued joined
+        self._fire_slow_decode()
+        try:
+            self._decode_once()
+        except BaseException as e:  # noqa: BLE001 — same containment
+            # as _decode_loop: the step's failure is the streams', not
+            # the fleet dispatcher's
+            self._recover_from_dispatch_error(e, "gen_decode_error")
+        return max(0.0, self.clock() - t0)
+
+    @property
+    def has_pending(self) -> bool:
+        """Whether the engine has work an external dispatcher should
+        schedule: active decode slots or queued prompts."""
+        return (any(s is not None for s in self._slots_state)
+                or self._batcher.queue_depth > 0)
 
     # ---- producer side -------------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
@@ -691,7 +757,8 @@ class GenerationEngine:
             self._slots_state[i] = None
         self._caches = self._decoder.init_cache()
         get_logger("serve").event(
-            event, error=f"{type(e).__name__}: {e}"[:300],
+            event, model=self.name,
+            error=f"{type(e).__name__}: {e}"[:300],
             failed_streams=failed)
 
     def _retire(self, slot: int, s: _Slot, now: float) -> None:
@@ -740,7 +807,7 @@ class GenerationEngine:
                 if s is not None and s.generated >= st["n"]:
                     st["fired"] = 1
                     get_logger("serve").event(
-                        "gen_fault_cancel", slot=i,
+                        "gen_fault_cancel", model=self.name, slot=i,
                         generated=s.generated, at_token=st["n"])
                     s.stream.cancel()
                     self._retire(i, s, now)
@@ -792,6 +859,7 @@ class GenerationEngine:
                     val = model._gather_host(model._params[p.name])
                     model._params[p.name] = model._placed_param(p, val)
             model._fwd_compiled.clear()
+            model._exec_digest_cache = None
             model.__dict__.pop("_gen_decoders", None)
             model._build_step_fns()
         return cls(model, **kwargs)

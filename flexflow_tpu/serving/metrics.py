@@ -48,10 +48,17 @@ class ServingMetrics:
 
     def __init__(self, window_s: float = 30.0, max_latency_samples: int = 4096,
                  clock: Callable[[], float] = time.monotonic,
-                 queue_depth_fn: Optional[Callable[[], int]] = None):
+                 queue_depth_fn: Optional[Callable[[], int]] = None,
+                 model: str = ""):
         self.window_s = float(window_s)
         self.clock = clock
         self.queue_depth_fn = queue_depth_fn
+        # tenant identity: every snapshot/serve_stats row carries
+        # ``model=<name>`` so two engines in one process (a model
+        # fleet) emit distinguishable event streams —
+        # calibration.harvest_serve_dispatch keys its dispatch entries
+        # on it ("" = the pre-fleet single-engine default)
+        self.model_tag = str(model)
         self._lock = threading.Lock()
         # every rolling-window structure and counter below is
         # guarded_by self._lock (RL009): records arrive from producer
@@ -262,6 +269,7 @@ class ServingMetrics:
             }
 
         return {
+            "model": self.model_tag,
             "qps": round(len(lats) / req_span, 3),
             "rows_per_sec": round(rows / span, 3),
             "batch_occupancy": round(occ, 4),

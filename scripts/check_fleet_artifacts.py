@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Repo static gate for fleet artifacts (scripts/static_checks.sh):
+
+* every shipped fleet registry JSON (``examples/serving/fleet.json``
+  and any ``examples/**/fleet*.json``) must pass
+  ``serving.fleet.validate_fleet_json`` — the SAME schema
+  ``ModelRegistry.from_json`` and ``flexflow-tpu lint --fleet``
+  enforce, so a committed registry can never rot silently;
+* every ``artifacts/fleet_bench_*.json`` must pass
+  ``serving.fleet.bench.validate_fleet_bench_json`` AND carry a
+  reconciled, zero-failed hot-swap leg — the acceptance evidence
+  stays checkable offline.
+
+Device-free and jax-free: pure JSON + schema functions.
+"""
+
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    from flexflow_tpu.serving.fleet import validate_fleet_json
+    from flexflow_tpu.serving.fleet.bench import validate_fleet_bench_json
+
+    failures = 0
+
+    registries = sorted(
+        glob.glob(os.path.join(REPO, "examples", "**", "fleet*.json"),
+                  recursive=True))
+    for path in registries:
+        rel = os.path.relpath(path, REPO)
+        try:
+            with open(path) as f:
+                obj = json.load(f)
+        except ValueError as e:
+            print(f"FAIL {rel}: not valid JSON: {e}")
+            failures += 1
+            continue
+        probs = validate_fleet_json(obj)
+        for p in probs:
+            print(f"FAIL {rel}: {p}")
+        failures += len(probs)
+        if not probs:
+            print(f"ok   {rel}: {len(obj['fleet'])} tenant(s)")
+
+    benches = sorted(
+        glob.glob(os.path.join(REPO, "artifacts", "fleet_bench_*.json")))
+    for path in benches:
+        rel = os.path.relpath(path, REPO)
+        try:
+            with open(path) as f:
+                obj = json.load(f)
+        except ValueError as e:
+            print(f"FAIL {rel}: not valid JSON: {e}")
+            failures += 1
+            continue
+        probs = validate_fleet_bench_json(obj)
+        summary = obj.get("summary") or {}
+        if not probs:
+            # the acceptance evidence itself (ISSUE 12): isolation,
+            # bounded queue, lossless swap — a regenerated artifact
+            # that regressed must fail the gate, not slide in
+            for key in ("isolation_holds", "a_queue_bounded",
+                        "swap_zero_failed", "swap_reconciled"):
+                if summary.get(key) is not True:
+                    probs.append(f"summary.{key} is not true")
+        for p in probs:
+            print(f"FAIL {rel}: {p}")
+        failures += len(probs)
+        if not probs:
+            print(f"ok   {rel}: b_goodput_ratio="
+                  f"{summary.get('b_goodput_ratio')}")
+
+    if not registries and not benches:
+        print("no fleet artifacts found (nothing to check)")
+    if failures:
+        print(f"fleet artifacts: {failures} problem(s)", file=sys.stderr)
+        return 1
+    print("fleet artifacts: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

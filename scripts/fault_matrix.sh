@@ -33,6 +33,11 @@ declare -a cases=(
   # (docs/serving.md "Token generation"; a mid-generation cancel must
   # free its KV slot and fail only its own stream)
   "$FAST_TIMEOUT tests/test_generation.py::TestGenerationFaults"
+  # fleet_load_fail / fleet_swap_at_dispatch: the model-fleet fault
+  # kinds — a failed background load must leave serving tenants
+  # untouched, and a held publish must land exactly at the pinned
+  # dispatch boundary (docs/serving.md "Model fleets")
+  "$FAST_TIMEOUT tests/test_fleet.py::TestFleetFaults"
 )
 if [ "${1:-}" != "--fast-only" ]; then
   cases+=(

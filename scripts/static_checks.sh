@@ -48,6 +48,13 @@ python -m flexflow_tpu.cli calibrate --check $calib_files || rc=1
 echo "== shipped strategy artifacts (lint + explain) =="
 python scripts/check_strategy_artifacts.py || rc=1
 
+# fleet registry JSONs (examples/**/fleet*.json) and fleet-bench
+# artifacts must pass the ONE schema lint/ModelRegistry enforce, and
+# the committed bench artifact must still carry its acceptance
+# evidence (isolation + lossless swap) — docs/serving.md "Model fleets"
+echo "== fleet artifacts (registry + bench schema) =="
+python scripts/check_fleet_artifacts.py || rc=1
+
 if [ "$rc" -eq 0 ]; then
     echo "static checks: OK"
 else

@@ -71,8 +71,10 @@ def test_dlrm_embedding_table_tp_parity():
 
 
 def test_dlrm_host_placed_tables():
-    """device_type HOST tables live in pinned_host memory and still train
+    """device_type HOST tables live in host memory (the backend's
+    feature-detected kind — compat.host_memory_kind) and still train
     (reference dlrm_strategy_hetero.cc CPU embeddings)."""
+    from flexflow_tpu.compat import host_memory_kind
     host = {f"embedding{i}": ParallelConfig(
         device_type=DeviceType.HOST, dims=(1, 1), device_ids=(0,),
         memory_types=(MemoryType.ZCM,) * 3) for i in range(4)}
@@ -81,7 +83,7 @@ def test_dlrm_host_placed_tables():
     assert losses[-1] < losses[0]
     for i in range(4):
         p = model._params[f"embedding{i}/table"]
-        assert p.sharding.memory_kind == "pinned_host", p.sharding
+        assert p.sharding.memory_kind == host_memory_kind(), p.sharding
     # numerics match the all-device run
     _, base = _train({"n": 2})
     np.testing.assert_allclose(base, losses, rtol=2e-4, atol=2e-5)
@@ -115,7 +117,8 @@ def test_dlrm_strategy_generator_roundtrip(tmp_path):
     model.compile(ff.SGDOptimizer(lr=0.05), metrics=[], final_tensor=preds,
                   mesh=MachineMesh({"n": 8}))
     model.init_layers(seed=0)
+    from flexflow_tpu.compat import host_memory_kind
     assert model._params["embedding0/table"].sharding.memory_kind == \
-        "pinned_host"
+        host_memory_kind()
     xs, y = _data()
     assert np.isfinite(float(model.train_batch(*xs, y)))

@@ -1,0 +1,570 @@
+"""Model-fleet subsystem tests (ISSUE 12, docs/serving.md "Model
+fleets"): the registry, the weighted-fair FleetEngine (isolation,
+hot load/unload/swap), the static co-residency gate pinned
+byte-for-byte against the engine's real allocations, per-model
+bucket-executable cache keys, multi-engine co-residency parity, the
+``model=`` event tags, and the fleet FF_FAULT kinds.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu import faults
+from flexflow_tpu.fflogger import capture_events, silenced
+from flexflow_tpu.parallel.mesh import MachineMesh
+from flexflow_tpu.serving.fleet import (FleetEngine, ModelRegistry,
+                                        fleet_gate_report, model_residency,
+                                        validate_fleet_json)
+from flexflow_tpu.serving.generation import GenerationEngine
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NFEAT, NCLS = 12, 6
+
+
+def _dense_builder(hidden, seed=0, mesh_shape=None):
+    def build(cfg):
+        cfg.seed = seed
+        m = ff.FFModel(cfg, mesh=MachineMesh(mesh_shape or {"n": 1}))
+        x = m.create_tensor((cfg.batch_size, NFEAT), name="x")
+        t = m.dense(x, hidden, activation="relu")
+        t = m.dense(t, NCLS)
+        return m
+    return build
+
+
+def _lm_builder(cfg):
+    from flexflow_tpu.models import build_transformer_lm
+    return build_transformer_lm(cfg, num_layers=1, d_model=32,
+                                num_heads=2, d_ff=64, seq_len=32,
+                                vocab_size=50)[0]
+
+
+def _registry(**a_kw):
+    reg = ModelRegistry()
+    reg.register("a", _dense_builder(24, seed=1), batch_size=8,
+                 serve={"max_wait_ms": 0.5, "stats_every": 0}, **a_kw)
+    reg.register("b", _dense_builder(40, seed=2), batch_size=8,
+                 serve={"max_wait_ms": 0.5, "stats_every": 0})
+    return reg
+
+
+def _rows(n, seed=0):
+    return np.random.default_rng(seed).standard_normal(
+        (n, NFEAT)).astype(np.float32)
+
+
+# ---------------------------------------------------------------------
+# registry + schema
+# ---------------------------------------------------------------------
+def test_fleet_json_schema_validation():
+    ok = {"fleet": [{"name": "m", "model": "transformer"}]}
+    assert validate_fleet_json(ok) == []
+    assert validate_fleet_json([]) != []
+    assert validate_fleet_json({"fleet": []}) != []
+    # duplicate names, bad engine, unknown serve key, negative weight
+    probs = validate_fleet_json({"fleet": [
+        {"name": "m", "model": "transformer"},
+        {"name": "m", "model": "dlrm", "engine": "nope",
+         "weight": -1, "serve": {"bogus_knob": 1}},
+    ]})
+    text = "\n".join(probs)
+    for frag in ("duplicate", "engine", "weight", "bogus_knob"):
+        assert frag in text, (frag, text)
+    # generation tenants must not carry a 'serve' section
+    probs = validate_fleet_json({"fleet": [
+        {"name": "g", "model": "transformer_lm", "engine": "generation",
+         "serve": {"max_batch": 4}}]})
+    assert any("generation" in p for p in probs)
+
+
+def test_registry_from_json_unknown_model_loud():
+    with pytest.raises(ValueError, match="unknown model"):
+        ModelRegistry.from_json(
+            {"fleet": [{"name": "x", "model": "not_a_model"}]})
+
+
+def test_shipped_example_fleet_is_schema_valid():
+    path = os.path.join(REPO, "examples", "serving", "fleet.json")
+    with open(path) as f:
+        obj = json.load(f)
+    assert validate_fleet_json(obj) == []
+    reg = ModelRegistry.from_json(obj)
+    assert set(reg.names()) == {"chat", "ranker", "recs"}
+
+
+# ---------------------------------------------------------------------
+# fleet engine: serve, fairness, swap, unload
+# ---------------------------------------------------------------------
+def test_fleet_serves_both_tenants_with_parity():
+    reg = _registry()
+    with silenced("serve"), FleetEngine(reg) as fleet:
+        xs = _rows(4)
+        fa = fleet.submit("a", xs)
+        fb = fleet.submit("b", xs)
+        ya, yb = fa.result(timeout=60), fb.result(timeout=60)
+        # each tenant's answer is ITS model's predict — bit-identical
+        ma = fleet._tenant("a").engine.model
+        mb = fleet._tenant("b").engine.model
+        np.testing.assert_array_equal(ya, ma.predict(xs, batch_size=4))
+        np.testing.assert_array_equal(yb, mb.predict(xs, batch_size=4))
+        assert not np.array_equal(ya, yb)  # different weights
+        s = fleet.stats()
+        assert set(s["tenants"]) == {"a", "b"}
+        assert s["tenants"]["a"]["requests"] == 1
+        assert s["tenants"]["a"]["model"] == "a"
+
+
+def test_fleet_weighted_fair_device_time():
+    """Both tenants saturated: accrued device time per weight should
+    equalize — the 2:1-weighted tenant gets ~2x the device seconds."""
+    reg = ModelRegistry()
+    reg.register("heavy", _dense_builder(24, seed=1), batch_size=8,
+                 weight=2.0, serve={"max_wait_ms": 0.2, "stats_every": 0})
+    reg.register("light", _dense_builder(24, seed=2), batch_size=8,
+                 weight=1.0, serve={"max_wait_ms": 0.2, "stats_every": 0})
+    n = 400
+    with silenced("serve"), FleetEngine(reg) as fleet:
+        xs = _rows(8)
+        futs_h, futs_l = [], []
+        for _ in range(n):
+            futs_h.append(fleet.submit("heavy", xs))
+            futs_l.append(fleet.submit("light", xs))
+        # equal backlogs, 2:1 weights: heavy is served at ~2x light's
+        # rate, so when heavy's LAST request completes, light should
+        # be only about halfway through its own backlog
+        for f in futs_h:
+            f.result(timeout=240)
+        light_done_at_h = fleet.stats("light")["requests"]
+        for f in futs_l:
+            f.result(timeout=240)
+    frac = light_done_at_h / n
+    # ideal 0.5; generous band for CPU timing noise and the coarse
+    # one-dispatch scheduling granularity
+    assert 0.2 < frac < 0.85, frac
+
+
+def test_fleet_qps_budget_throttles_tenant():
+    """A tenant with a qps_rows budget is paced by the token bucket
+    even with the device otherwise free; an unbudgeted tenant is
+    not."""
+    reg = ModelRegistry()
+    reg.register("capped", _dense_builder(24, seed=1), batch_size=8,
+                 qps_rows=400.0,
+                 serve={"max_wait_ms": 0.2, "stats_every": 0})
+    with silenced("serve"), FleetEngine(reg) as fleet:
+        xs = _rows(8)
+        t0 = time.monotonic()
+        futs = [fleet.submit("capped", xs) for _ in range(120)]
+        for f in futs:
+            f.result(timeout=60)
+        elapsed = time.monotonic() - t0
+    # 960 rows at 400 rows/s minus the 1-second-burst initial
+    # allowance needs > 1s of pacing — far above the ~50ms an
+    # unthrottled run takes
+    assert elapsed > 0.8, elapsed
+
+
+def test_fleet_hot_swap_zero_failed_and_reconciled():
+    """A swap under continuous load: zero in-flight failures, counters
+    reconciled exactly across the engine generations (the acceptance
+    identity), and post-swap answers come from the NEW weights."""
+    reg = _registry()
+    xs = _rows(4)
+    results = {"ok": 0, "admission": 0, "failed": 0}
+    stop = threading.Event()
+
+    with silenced("serve"), FleetEngine(reg) as fleet:
+        old_out = fleet.submit("a", xs).result(timeout=60)
+
+        def pump():
+            from flexflow_tpu.serving.errors import ServingError
+            while not stop.is_set():
+                try:
+                    fleet.submit("a", xs).result(timeout=60)
+                    results["ok"] += 1
+                except ServingError:
+                    results["admission"] += 1
+                except Exception:
+                    results["failed"] += 1
+        th = threading.Thread(target=pump)
+        th.start()
+        time.sleep(0.1)
+        reg.register("a", _dense_builder(24, seed=77), batch_size=8,
+                     serve={"max_wait_ms": 0.5, "stats_every": 0})
+        fleet.load("a", wait=True, timeout=120)
+        time.sleep(0.1)
+        stop.set()
+        th.join()
+        new_out = fleet.submit("a", xs).result(timeout=60)
+        st = fleet.stats("a")
+
+    assert results["failed"] == 0, results
+    assert results["ok"] > 0
+    assert st["engine_generation"] == 1
+    # new checkpoint actually serving (different init seed)
+    assert not np.array_equal(old_out, new_out)
+    # exact reconciliation: every submitted request has exactly one
+    # outcome, continuous across the swap
+    submitted = results["ok"] + results["admission"] + 2
+    assert (st["requests"] + st["rejected"] + st["shed"]
+            + st["expired"] + st["errors"]) == submitted, (st, results)
+
+
+def test_fleet_generation_swap_retires_active_streams():
+    """Swapping a generation tenant mid-stream: the old engine's
+    active decode slots cannot move (their KV state is engine-local),
+    so the fleet keeps stepping the RETIRING engine until every
+    stream finishes — no stream is stranded or shed, and new prompts
+    decode on the new engine.  The serve_slow_decode fault paces the
+    stream so the publish deterministically lands mid-flight; the
+    replacement engine is pre-warmed so the publish itself is
+    instant."""
+    os.environ["FF_FAULT"] = "serve_slow_decode:200,ms=40"
+    faults.reset()
+    try:
+        cfg2 = ff.FFConfig(batch_size=2, compute_dtype="float32")
+        new_model = _lm_builder(cfg2)
+        new_model.compile(ff.SGDOptimizer(lr=0.01),
+                          mesh=MachineMesh({"n": 1}))
+        new_model.init_layers(seed=5)
+        new_eng = GenerationEngine(new_model, slots=2,
+                                   max_new_tokens=24, name="chat",
+                                   stats_every=0)
+        with silenced("serve"):
+            new_eng.begin_external_dispatch()  # pre-warm off the clock
+
+        reg = ModelRegistry()
+        reg.register("chat", _lm_builder, engine="generation",
+                     batch_size=2,
+                     generation={"slots": 2, "max_new_tokens": 24,
+                                 "stats_every": 0})
+        prompt = [3, 1, 4]
+        with silenced("serve"), capture_events("serve") as events, \
+                FleetEngine(reg) as fleet:
+            stream = fleet.submit("chat", prompt, max_new_tokens=24)
+            next(iter(stream))  # live in a decode slot, ~40ms/token
+            fleet.add_engine("chat", new_eng)
+            deadline = time.monotonic() + 60
+            while fleet.stats("chat")["engine_generation"] < 1:
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
+            # the in-flight stream completes on the retiring engine
+            out = stream.result(timeout=120)
+            assert out.shape == (24,)
+            # and new prompts decode on the replacement
+            out2 = fleet.submit("chat", prompt,
+                                max_new_tokens=3).result(timeout=120)
+            assert out2.size > 0
+        kinds = [e["event"] for e in events]
+        assert "fleet_publish" in kinds
+        assert "fleet_retired" in kinds  # the old engine finalized
+    finally:
+        os.environ.pop("FF_FAULT", None)
+        faults.reset()
+
+
+def test_fleet_unload_drains_and_detaches():
+    reg = _registry()
+    with silenced("serve"), FleetEngine(reg) as fleet:
+        xs = _rows(4)
+        futs = [fleet.submit("a", xs) for _ in range(8)]
+        snap = fleet.unload("a", timeout=30)
+        assert snap["requests"] == 8
+        for f in futs:
+            assert f.result(timeout=5).shape == (4, NCLS)
+        assert fleet.names() == ["b"]
+        with pytest.raises(KeyError, match="no resident model"):
+            fleet.submit("a", xs)
+        # the other tenant is untouched
+        assert fleet.submit("b", xs).result(timeout=60).shape == (4, NCLS)
+
+
+def test_fleet_generation_tenant_token_parity():
+    """A generation tenant inside the fleet produces the same tokens a
+    solo GenerationEngine produces for the same model/prompt."""
+    cfg = ff.FFConfig(batch_size=2, compute_dtype="float32", seed=0)
+    solo_lm = _lm_builder(cfg)
+    solo_lm.compile(ff.SGDOptimizer(lr=0.01),
+                    mesh=MachineMesh({"n": 1}))
+    solo_lm.init_layers(seed=0)
+    prompt = [3, 1, 4, 1, 5]
+    with silenced("serve"):
+        with GenerationEngine(solo_lm, slots=2, max_new_tokens=6) as eng:
+            want = list(eng.submit(prompt))
+
+        reg = ModelRegistry()
+        reg.register("chat", _lm_builder, engine="generation",
+                     batch_size=2,
+                     generation={"slots": 2, "max_new_tokens": 6,
+                                 "stats_every": 0})
+        reg.register("a", _dense_builder(24, seed=1), batch_size=8,
+                     serve={"max_wait_ms": 0.5, "stats_every": 0})
+        with FleetEngine(reg) as fleet:
+            # dense traffic interleaves with the decode steps
+            futs = [fleet.submit("a", _rows(4)) for _ in range(6)]
+            got = list(fleet.submit("chat", prompt))
+            for f in futs:
+                f.result(timeout=60)
+    assert got == want, (got, want)
+
+
+# ---------------------------------------------------------------------
+# co-residency gate: byte-for-byte pin + lint --fleet acceptance
+# ---------------------------------------------------------------------
+def test_gate_matches_engine_allocations_byte_for_byte():
+    """The acceptance pin: the gate's per-model resident-bytes
+    prediction equals the engine's REAL per-device allocation exactly —
+    dense (params) and generation (params + KV cache) tenants both."""
+    reg = ModelRegistry()
+    reg.register("d", _dense_builder(24, seed=1), batch_size=8,
+                 serve={"max_wait_ms": 0.5, "stats_every": 0})
+    reg.register("g", _lm_builder, engine="generation", batch_size=2,
+                 generation={"slots": 2, "max_seq": 32,
+                             "max_new_tokens": 4, "stats_every": 0})
+    predicted = {}
+    for name in reg.names():
+        model, strategies = reg.graph(name)
+        row = model_residency(reg.spec(name), model.layers,
+                              model.input_tensors, strategies)
+        predicted[name] = row["resident_bytes"]
+    with silenced("serve"), FleetEngine(reg) as fleet:
+        for name in reg.names():
+            real = fleet.stats(name)["resident_bytes"]
+            assert real == predicted[name], (
+                name, real, predicted[name])
+
+
+def test_lint_fleet_rejects_over_hbm_and_passes_minus_one(tmp_path):
+    """The acceptance flip: the full fleet overflows a budget that the
+    same fleet minus one model fits — FF130 appears exactly on the
+    over-budget run and lint's exit code flips with it."""
+    full = {"fleet": [
+        {"name": "ranker", "model": "transformer", "batch_size": 32},
+        {"name": "recs", "model": "dlrm"},
+    ]}
+    minus_one = {"fleet": full["fleet"][:1]}
+    p_full = tmp_path / "fleet_full.json"
+    p_min = tmp_path / "fleet_min.json"
+    p_full.write_text(json.dumps(full))
+    p_min.write_text(json.dumps(minus_one))
+
+    def lint(path):
+        r = subprocess.run(
+            [sys.executable, "-m", "flexflow_tpu.cli", "lint",
+             "--fleet", str(path), "--hbm-gb", "6", "--json"],
+            capture_output=True, text=True, cwd=REPO, timeout=300,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        return r.returncode, r.stdout
+
+    rc_full, out_full = lint(p_full)
+    rc_min, out_min = lint(p_min)
+    assert rc_full == 1 and rc_min == 0, (rc_full, rc_min)
+    codes_full = [d["code"] for d in
+                  json.loads(out_full)["diagnostics"]]
+    codes_min = [d["code"] for d in json.loads(out_min)["diagnostics"]]
+    assert "FF130" in codes_full and "FF131" in codes_full
+    assert "FF130" not in codes_min and "FF131" in codes_min
+
+
+def test_fleet_gate_report_sums_tenants():
+    reg = _registry()
+    report, rows = fleet_gate_report(reg, hbm_gb=16.0)
+    assert [r["name"] for r in rows] == ["a", "b"]
+    assert all(r["resident_bytes"] > 0 for r in rows)
+    assert report.codes().count("FF131") == 2
+    assert not report.errors
+    # a budget below the total flips FF130
+    total_gb = sum(r["ff108_bytes"] for r in rows) / 1e9
+    report2, _ = fleet_gate_report(reg, hbm_gb=total_gb / 2)
+    assert "FF130" in report2.codes()
+
+
+# ---------------------------------------------------------------------
+# per-model bucket-executable cache keys (satellite: collision test)
+# ---------------------------------------------------------------------
+def test_two_model_bucket_executables_never_collide():
+    """Two models with IDENTICAL graph shapes but different weights:
+    forward_compiled's (bucket, exec_digest) keys keep their
+    executables apart, and each engine answers with ITS model's
+    numbers.  Also pins that a graph difference changes the digest."""
+    cfg_a = ff.FFConfig(batch_size=8, compute_dtype="float32", seed=1)
+    cfg_b = ff.FFConfig(batch_size=8, compute_dtype="float32", seed=2)
+    ma = _dense_builder(24, seed=1)(cfg_a)
+    mb = _dense_builder(24, seed=2)(cfg_b)
+    for m in (ma, mb):
+        m.compile(ff.SGDOptimizer(lr=0.01))
+        m.init_layers(seed=m.config.seed)
+    # same graph, same shapes -> same digest is FINE (the executable
+    # is param-free); the cache must still be per-model
+    assert ma.exec_digest() == mb.exec_digest()
+    fa, fb = ma.forward_compiled(8), mb.forward_compiled(8)
+    assert (8, ma.exec_digest()) in ma._fwd_compiled
+    assert (8, mb.exec_digest()) in mb._fwd_compiled
+    assert ma._fwd_compiled is not mb._fwd_compiled
+    xs = _rows(8)
+    ya = ma.predict(xs, batch_size=8)
+    yb = mb.predict(xs, batch_size=8)
+    assert not np.array_equal(ya, yb)  # different weights, own answers
+    # a DIFFERENT graph gets a different digest (so a registry keyed on
+    # (bucket, digest) can never hand B an executable lowered for A)
+    cfg_c = ff.FFConfig(batch_size=8, compute_dtype="float32")
+    mc = _dense_builder(40, seed=1)(cfg_c)
+    mc.compile(ff.SGDOptimizer(lr=0.01))
+    mc.init_layers(seed=0)
+    assert mc.exec_digest() != ma.exec_digest()
+    # re-compile resets the digest cache with the executables
+    ma.compile(ff.SGDOptimizer(lr=0.01))
+    assert ma._fwd_compiled == {}
+    assert ma.exec_digest() == mb.exec_digest()  # graph unchanged
+    _ = fa, fb
+
+
+# ---------------------------------------------------------------------
+# multi-engine co-residency (own threads, no fleet) + model tags
+# ---------------------------------------------------------------------
+def test_dense_and_generation_engines_coreside_with_parity():
+    """Two LIVE engines — one dense (own dispatcher thread), one
+    generation (own decode thread) — serving concurrently in one
+    process: both answer exactly what their solo runs answer."""
+    from flexflow_tpu.serving import ServingEngine
+
+    cfg_d = ff.FFConfig(batch_size=8, compute_dtype="float32", seed=1)
+    dense = _dense_builder(24, seed=1)(cfg_d)
+    dense.compile(ff.SGDOptimizer(lr=0.01))
+    dense.init_layers(seed=1)
+    cfg_g = ff.FFConfig(batch_size=2, compute_dtype="float32", seed=0)
+    lm = _lm_builder(cfg_g)
+    lm.compile(ff.SGDOptimizer(lr=0.01), mesh=MachineMesh({"n": 1}))
+    lm.init_layers(seed=0)
+
+    xs = _rows(4)
+    prompt = [7, 2, 9]
+    want_dense = dense.predict(xs, batch_size=4)
+    with silenced("serve"):
+        with GenerationEngine(lm, slots=2, max_new_tokens=5) as solo_g:
+            want_tokens = list(solo_g.submit(prompt))
+
+        # fresh engines, live CONCURRENTLY
+        with ServingEngine(dense, name="dense", stats_every=0) as se:
+            gen = GenerationEngine(lm, slots=2, max_new_tokens=5,
+                                   name="lm")
+            with gen:
+                streams = [gen.submit(prompt) for _ in range(3)]
+                futs = [se.submit(xs) for _ in range(12)]
+                tok_lists = [list(s) for s in streams]
+                outs = [f.result(timeout=60) for f in futs]
+    for out in outs:
+        np.testing.assert_array_equal(out, want_dense)
+    for toks in tok_lists:
+        assert toks == want_tokens, (toks, want_tokens)
+
+
+def test_serve_events_carry_model_tag():
+    """serve_stats / serve_health / gen_stats rows carry model=<name>
+    so two engines' interleaved streams stay distinguishable, and
+    harvest_serve_dispatch keys on the tag."""
+    from flexflow_tpu.search.calibration import (CalibrationTable,
+                                                 harvest_serve_dispatch)
+    from flexflow_tpu.serving import ServingEngine
+
+    cfg = ff.FFConfig(batch_size=8, compute_dtype="float32")
+    model = _dense_builder(24)(cfg)
+    model.compile(ff.SGDOptimizer(lr=0.01))
+    model.init_layers(seed=0)
+    with capture_events("serve") as events:
+        with ServingEngine(model, name="ranker", stats_every=1) as eng:
+            eng.submit(_rows(4)).result(timeout=60)
+            snap = eng.stats()
+    assert snap["model"] == "ranker"
+    tagged = [e for e in events
+              if e["event"] in ("serve_stats", "serve_health")]
+    assert tagged and all(e["model"] == "ranker" for e in tagged)
+    # the calibration harvest keys on the tag when no name is given
+    table = CalibrationTable()
+    n = harvest_serve_dispatch(table, None, snap)
+    assert n >= 1
+    assert all(k.startswith("serve|ranker|") for k in table.dispatch)
+
+
+def test_serve_model_name_config_default():
+    cfg = ff.FFConfig(batch_size=8, compute_dtype="float32")
+    cfg.serve_model_name = "cfg-tag"
+    model = _dense_builder(24)(cfg)
+    model.compile(ff.SGDOptimizer(lr=0.01))
+    model.init_layers(seed=0)
+    from flexflow_tpu.serving import ServingEngine
+    eng = ServingEngine(model, stats_every=0)
+    assert eng.name == "cfg-tag"
+    assert eng.metrics.snapshot()["model"] == "cfg-tag"
+
+
+# ---------------------------------------------------------------------
+# FF_FAULT fleet kinds
+# ---------------------------------------------------------------------
+class TestFleetFaults:
+    def setup_method(self):
+        faults.reset()
+
+    def teardown_method(self):
+        os.environ.pop("FF_FAULT", None)
+        faults.reset()
+
+    def test_grammar_parses_fleet_kinds(self):
+        specs = faults.parse_faults(
+            "fleet_load_fail:ranker;fleet_swap_at_dispatch:5")
+        assert specs[0].kind == "fleet_load_fail"
+        assert specs[0].arg == "ranker"
+        assert specs[1].kind == "fleet_swap_at_dispatch"
+        assert specs[1].arg == "5"
+        with pytest.raises(ValueError, match="missing"):
+            faults.parse_faults("fleet_load_fail")
+
+    def test_fleet_load_fail_leaves_serving_tenants_untouched(self):
+        os.environ["FF_FAULT"] = "fleet_load_fail:newbie"
+        faults.reset()
+        reg = _registry()
+        with silenced("serve"), capture_events("serve") as events, \
+                FleetEngine(reg) as fleet:
+            xs = _rows(4)
+            assert fleet.submit("a", xs).result(timeout=60) is not None
+            reg.register("newbie", _dense_builder(24, seed=9),
+                         batch_size=8,
+                         serve={"max_wait_ms": 0.5, "stats_every": 0})
+            with pytest.raises(RuntimeError, match="fleet load"):
+                fleet.load("newbie", wait=True, timeout=60)
+            # the failed load never became a tenant; serving continues
+            assert fleet.names() == ["a", "b"]
+            assert fleet.submit("a", xs).result(timeout=60) is not None
+        errs = [e for e in events if e["event"] == "fleet_load_error"]
+        assert errs and errs[0]["model"] == "newbie"
+
+    def test_fleet_swap_at_dispatch_holds_publish(self):
+        os.environ["FF_FAULT"] = "fleet_swap_at_dispatch:3"
+        faults.reset()
+        reg = _registry()
+        xs = _rows(4)
+        with silenced("serve"), capture_events("serve") as events, \
+                FleetEngine(reg) as fleet:
+            reg.register("a", _dense_builder(24, seed=77), batch_size=8,
+                         serve={"max_wait_ms": 0.5, "stats_every": 0})
+            done = fleet.load("a", wait=False)
+            # publishes are HELD until fleet dispatch index 3: drive
+            # dispatches through tenant b until the swap lands
+            deadline = time.monotonic() + 60
+            while not done.is_set():
+                fleet.submit("b", xs).result(timeout=60)
+                assert time.monotonic() < deadline
+            st = fleet.stats("a")
+            assert st["engine_generation"] == 1
+        pubs = [e for e in events if e["event"] == "fleet_publish"]
+        assert pubs and pubs[0]["swap"] is True
+        # the publish landed at or after the held dispatch index
+        assert fleet._n_dispatch >= 3

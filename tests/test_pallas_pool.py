@@ -167,9 +167,10 @@ def test_sharded_grad_matches_autodiff():
     x = jax.device_put(x, NamedSharding(mm.mesh, spec))
 
     def via_pallas(v):
-        return jax.shard_map(
+        from flexflow_tpu.compat import shard_map
+        return shard_map(
             lambda u: pallas_max_pool_nhwc(u, (3, 3), (2, 2), (0, 0)),
-            mesh=mm.mesh, in_specs=(spec,), out_specs=spec,
+            mm.mesh, in_specs=(spec,), out_specs=spec,
             check_vma=False)(v)
 
     g1 = jax.jit(jax.grad(lambda v: jnp.sum(via_pallas(v))))(x)

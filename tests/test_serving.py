@@ -427,8 +427,10 @@ def test_engine_predict_parity_bitwise(mesh_shape):
     sizes = [1, 3, 4, 7, 16, 5, 2, 40, 8, 1, 6, 16]
     reqs = _requests(sizes)
     eng = ServingEngine(m, stats_every=0)
-    # AOT-warm at startup, in the cache predict() shares
-    assert set(eng.buckets) <= set(m._fwd_compiled)
+    # AOT-warm at startup, in the cache predict() shares (keys are
+    # (bucket, exec_digest) — the digest half keeps fleet tenants'
+    # executables apart, tests/test_fleet.py)
+    assert set(eng.buckets) <= {b for b, _ in m._fwd_compiled}
     with eng:
         futs = [eng.submit(r) for r in reqs]
         outs = [f.result(timeout=60) for f in futs]
@@ -974,7 +976,8 @@ def test_forward_compiled_cached_and_shared_with_predict():
     assert m.forward_compiled(8) is c8            # cached per bucket
     x = np.zeros((10, NFEAT), np.float32)
     m.predict(x, batch_size=4)
-    assert 4 in m._fwd_compiled                   # predict shares the cache
+    # predict shares the (bucket, exec_digest)-keyed cache
+    assert (4, m.exec_digest()) in m._fwd_compiled
     assert 4 in m._dummy_labels                   # label feed cached per bs
     with pytest.raises(ValueError, match="bucket batch size"):
         m.forward_compiled(0)
