@@ -18,7 +18,7 @@ if _os.environ.get("FLEXFLOW_PLATFORM"):
 
     _jax.config.update("jax_platforms", _os.environ["FLEXFLOW_PLATFORM"])
 
-from . import losses, metrics
+from . import losses, metrics, obs
 from .analysis import (Diagnostic, DiagnosticReport, Severity,
                        VerificationError, verify)
 from .config import (CompMode, DeviceType, FFConfig, MemoryType,

@@ -61,6 +61,15 @@ def main(argv=None) -> None:
         # device-free sharding/communication/memory report for a
         # strategy on a mesh you may not own yet (docs/verifier.md)
         raise SystemExit(explain_main(argv[1:]))
+    if argv and argv[0] == "trace":
+        # export/inspect recorded request-span traces
+        # (docs/observability.md)
+        from .obs.trace import trace_main
+        raise SystemExit(trace_main(argv[1:]))
+    if argv and argv[0] == "flight":
+        # flight-recorder post-mortem dumps (docs/observability.md)
+        from .obs.flight import flight_main
+        raise SystemExit(flight_main(argv[1:]))
     script = None
     for a in argv:
         if a.endswith(".py"):
@@ -85,6 +94,8 @@ def main(argv=None) -> None:
               "       flexflow-tpu explain --model NAME [--strategy "
               "s.pb] [--mesh n=4,c=2] [--json]\n"
               "       flexflow-tpu explain --fleet fleet.json [--json]\n"
+              "       flexflow-tpu trace export RAW.json [--out f.json]\n"
+              "       flexflow-tpu flight dump|show [--dir D]\n"
               "flags (reference model.cc:1221-1289): -e -b --lr --wd -d "
               "--budget --alpha --reshard-budget -s/-import -ll:tpu "
               "-ll:cpu --nodes --profiling --seed --remat "
@@ -92,13 +103,24 @@ def main(argv=None) -> None:
               "--cost-estimator "
               "--serve-max-batch --serve-max-wait-ms --serve-buckets "
               "--serve-max-queue-rows --serve-admission "
-              "--serve-starvation-ms",
+              "--serve-starvation-ms --trace-sample-rate --metrics-port",
               file=sys.stderr)
         raise SystemExit(2)
     flags = [a for a in argv if a != script]
     cfg = FFConfig.parse_args(flags)
     import flexflow_tpu
     flexflow_tpu.set_default_config(cfg)
+    # observability plane (docs/observability.md): a fatal uncaught
+    # exception in the user script dumps the flight ring before the
+    # traceback prints; --metrics-port exposes the process registry
+    from .obs.flight import install_excepthook
+    install_excepthook()
+    if cfg.metrics_port > 0:
+        from .obs.registry import start_metrics_server
+        server = start_metrics_server(cfg.metrics_port,
+                                      host=cfg.metrics_host)
+        print(f"[obs] metrics on {cfg.metrics_host}:"
+              f"{server.server_port}/metrics", file=sys.stderr)
     # bring up the multi-host runtime when this is one process of a slice
     # (single-process runs are a no-op) — the reference's GASNet bring-up
     # happens likewise before the top-level task runs.  --nodes > 1 makes

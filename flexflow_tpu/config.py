@@ -168,6 +168,23 @@ class FFConfig:
     # dump lands here (TensorBoard-loadable) — the XLA-level complement of
     # --profiling's per-op table
     trace_dir: str = ""
+    # Observability plane (flexflow_tpu/obs, docs/observability.md).
+    # trace_sample_rate: fraction of submit()/fit() requests that get a
+    # request-scoped span trace (0 = tracing fully off — the hot path
+    # pays one lock-free boolean check per dispatch; 1.0 = every
+    # request, deterministic systematic sampling, no RNG).  Export the
+    # recorded spans with `flexflow-tpu trace export`.
+    trace_sample_rate: float = 0.0
+    # metrics_port: serve the process metrics registry's Prometheus
+    # text exposition on GET /metrics at this port (stdlib HTTP, daemon
+    # thread; 0 = no endpoint).  The registry backs the
+    # serve_stats/gen_stats events, so the scrape and the event stream
+    # cannot diverge.  metrics_host defaults to LOOPBACK — the
+    # exposition names tenants and their traffic; binding a routable
+    # interface ("0.0.0.0" for a cluster scraper) is an explicit
+    # choice via --metrics-host.
+    metrics_port: int = 0
+    metrics_host: str = "127.0.0.1"
     # Gradient accumulation: split each batch into k equal microbatches
     # inside the ONE jitted train step (lax.scan), accumulate grads, and
     # apply a single optimizer update — activation memory scales with
@@ -375,6 +392,12 @@ class FFConfig:
                 cfg.serve_gen_max_seq = int(val())
             elif a == "--serve-gen-max-new":
                 cfg.serve_gen_max_new_tokens = int(val())
+            elif a == "--trace-sample-rate":
+                cfg.trace_sample_rate = float(val())
+            elif a == "--metrics-port":
+                cfg.metrics_port = int(val())
+            elif a == "--metrics-host":
+                cfg.metrics_host = val()
             # unknown flags pass through (reference forwards Legion flags)
             i += 1
         return cfg

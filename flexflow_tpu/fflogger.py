@@ -21,8 +21,9 @@ import contextlib
 import json
 import os
 import sys
+import threading
 import time
-from typing import Any, Dict
+from typing import Any, Callable, Dict
 
 _LEVELS = {"debug": 10, "info": 20, "warning": 30, "error": 40, "none": 100}
 _DEFAULT_LEVEL = "info"
@@ -68,23 +69,65 @@ class Category:
         Active :func:`capture_events` contexts receive the record dict
         regardless of level — a harness harvesting events (e.g.
         ``flexflow-tpu calibrate`` reading fit()'s ``dispatch_ms``) must
-        see them even while the stdout stream is silenced."""
+        see them even while the stdout stream is silenced.
+
+        Timestamps: ``t`` is the human wall clock (coarse, steppable);
+        ``t_ns`` is ``time.monotonic_ns()`` — the ORDERING field.  The
+        old wall-clock-only stamp rounded to 1 ms, collapsing
+        sub-millisecond serving/decode events and running backwards
+        under NTP steps; consumers ordering/deltaing events must use
+        ``t_ns`` (pinned in tests/test_logging.py)."""
         rec: Dict[str, Any] = {"cat": self.name, "event": event,
-                               "t": round(time.time(), 3)}
+                               "t": round(time.time(), 3),
+                               "t_ns": time.monotonic_ns()}
         rec.update(fields)
+        # snapshot the capture/tap lists under the lock, then call
+        # outside it: the serving dispatcher thread emits events while
+        # other threads enter/exit capture_events contexts — iterating
+        # the live list raced its mutation (pinned threaded in
+        # tests/test_logging.py)
+        with _capture_lock:
+            captures = list(_captures)
+            taps = list(_taps)
         muted = False
-        for names, sink, mute in _captures:
+        for names, sink, mute in captures:
             if names is None or self.name in names:
                 sink.append(dict(rec))
                 muted = muted or mute
+        for tap in taps:
+            # passive observers (the obs.flight ring): mute-agnostic,
+            # and a broken tap must never take the emitting path down
+            try:
+                tap(dict(rec))
+            except Exception:  # noqa: BLE001
+                pass
         if muted or _LEVELS["info"] < self.level:
             return
         print(json.dumps(rec), flush=True)
 
 
 _registry: Dict[str, Category] = {}
+# guards _captures and _taps: entries are added/removed from producer
+# threads while Category.event iterates concurrently
+_capture_lock = threading.Lock()
 # active capture_events contexts: (category-name filter | None, sink, mute)
-_captures: list = []
+_captures: list = []  # guarded_by: _capture_lock
+# passive event observers: fn(record_dict), called for EVERY event
+# regardless of level/mute (the flight recorder's tap)
+_taps: list = []  # guarded_by: _capture_lock
+
+
+def add_tap(fn: Callable[[Dict], None]) -> None:
+    """Register a passive observer of every event record (idempotent)."""
+    with _capture_lock:
+        if fn not in _taps:
+            _taps.append(fn)
+
+
+def remove_tap(fn: Callable[[Dict], None]) -> None:
+    with _capture_lock:
+        if fn in _taps:
+            _taps.remove(fn)
 
 
 @contextlib.contextmanager
@@ -98,17 +141,19 @@ def capture_events(*names: str, mute: bool = True):
     works even under :func:`silenced` (it hooks before the level gate)."""
     sink: list = []
     entry = (frozenset(names) or None, sink, mute)
-    _captures.append(entry)
+    with _capture_lock:
+        _captures.append(entry)
     try:
         yield sink
     finally:
         # remove by identity, not equality: two nested captures with the
         # same filter compare equal once their sinks hold equal events,
         # and list.remove() would pop the OUTER entry
-        for i in range(len(_captures) - 1, -1, -1):
-            if _captures[i] is entry:
-                del _captures[i]
-                break
+        with _capture_lock:
+            for i in range(len(_captures) - 1, -1, -1):
+                if _captures[i] is entry:
+                    del _captures[i]
+                    break
 
 
 def get_logger(name: str) -> Category:

@@ -1954,6 +1954,13 @@ class FFModel:
         import contextlib
         tracer = (jax.profiler.trace(cfg.trace_dir) if cfg.trace_dir
                   else contextlib.nullcontext())
+        # span tracing (docs/observability.md): one trace id per fit()
+        # call; every dispatched window below records a `train_window`
+        # span against it — the training-side siblings of the serving
+        # request spans, on the same exportable timeline
+        from .obs.trace import tracer_from_config
+        span_tr = tracer_from_config(cfg)
+        fit_trace = span_tr.new_trace() if span_tr.active else None
         from .data.dataloader import PrefetchLoader
         loader = PrefetchLoader(self, xs, y, batch_size=bs,
                                 steps_per_dispatch=k, pad_tail=pad)
@@ -1968,14 +1975,22 @@ class FFModel:
                 epoch_sums = []
                 epoch_losses = []
                 dispatches, dispatch_time = 0, 0.0
+                epoch_step0 = self._step
                 if use_windows:
                     # fused multi-step path: one host re-entry per K-step
                     # window; losses/sums stack on device inside the scan
                     for window, nvalid in loader.iter_windows():
                         t_d = time.perf_counter()
+                        step0 = self._step
                         losses, sums = self.train_window(window, nvalid)
-                        dispatch_time += time.perf_counter() - t_d
+                        t_d1 = time.perf_counter()
+                        dispatch_time += t_d1 - t_d
                         dispatches += 1
+                        if fit_trace is not None:
+                            span_tr.span(
+                                "train_window", fit_trace, t_d, t_d1,
+                                cat="train", tid="train", epoch=epoch,
+                                step0=step0, steps=self._step - step0)
                         epoch_losses.append(losses)
                         epoch_sums.append(sums)
                 else:
@@ -2029,6 +2044,24 @@ class FFModel:
                          if k != "samples_seen"})
                     # callbacks watch these (keras-style early stopping)
                     self.perf_metrics.val_scalars = val_scalars
+                # train-loop stats feed the process metrics registry
+                # (docs/observability.md "Metrics"): the epoch event
+                # below and a /metrics scrape report the same numbers
+                from .obs.registry import get_registry
+                _reg = get_registry()
+                _reg.counter("ff_train_steps_total",
+                             "Optimizer steps executed").labels().inc(
+                    self._step - epoch_step0)
+                _reg.counter("ff_train_dispatches_total",
+                             "Training dispatches (fused windows count "
+                             "once)").labels().inc(dispatches)
+                _reg.counter("ff_train_samples_total",
+                             "Training samples consumed").labels().inc(
+                    loader.num_samples_used)
+                _reg.gauge("ff_train_dispatch_ms",
+                           "Mean wall ms per training dispatch, last "
+                           "epoch").labels().set(
+                    dispatch_time / max(1, dispatches) * 1e3)
                 # structured per-epoch record (one parseable JSON line; the
                 # reference only had printf metrics — SURVEY §5 observability)
                 from .fflogger import get_logger

@@ -230,6 +230,10 @@ def run_elastic(worker_argv: Callable[[int, int, int], Sequence[str]],
     Returns an :class:`ElasticReport`; ``success`` means some attempt
     had every worker exit 0."""
     attempts: List[AttemptResult] = []
+    # install the flight-recorder taps up front so the FIRST attempt
+    # failure's dump already holds the supervisor's event trail
+    from ..obs.flight import get_flight
+    get_flight()
     hb_root = heartbeat_root or tempfile.mkdtemp(prefix="ff_hb_")
     backoffs = backoff_schedule(max_restarts, backoff_base_s,
                                 backoff_max_s, backoff_jitter, backoff_seed)
@@ -358,6 +362,18 @@ def run_elastic(worker_argv: Callable[[int, int, int], Sequence[str]],
         attempts.append(result)
         if cause == "ok" and all(c == 0 for c in result.returncodes):
             return ElasticReport(True, attempts)
+        # supervisor attempt failure: a flight-recorder trigger (no-op
+        # unless FF_FLIGHT_DIR is set) — the dump retains the recent
+        # degrade/checkpoint_skipped/heartbeat event trail plus this
+        # attempt's classification and per-rank tails
+        from ..obs.flight import flight_dump
+        flight_dump("elastic_attempt_failed", extra={
+            "attempt": attempt, "cause": cause,
+            "num_processes": nproc_cur,
+            "returncodes": result.returncodes,
+            "failed_rank": failed_rank,
+            "tail": (result.tails.get(failed_rank, "")[-400:]
+                     if failed_rank is not None else "")})
         if (attempt == 0 and cause == "crash"
                 and result.elapsed_s <= fail_fast_window_s
                 and result.returncodes

@@ -132,14 +132,17 @@ class Request:
     optional zero-arg predicate — True means the logical request is
     already resolved (a sibling chunk expired/failed, or the client
     cancelled) and this entry is dropped silently at the next scan
-    instead of burning dispatch rows."""
+    instead of burning dispatch rows; ``trace`` is the request's
+    sampled trace id (obs.trace) or None — the batcher never reads it,
+    the dispatcher stamps its ``queue`` span with it."""
 
     __slots__ = ("xs", "n", "on_done", "t_submit", "deadline", "priority",
-                 "stale")
+                 "stale", "trace")
 
     def __init__(self, xs, n: int, on_done, t_submit: float,
                  deadline: Optional[float] = None, priority: int = 0,
-                 stale: Optional[Callable[[], bool]] = None):
+                 stale: Optional[Callable[[], bool]] = None,
+                 trace: Optional[str] = None):
         self.xs = xs
         self.n = n
         self.on_done = on_done
@@ -147,6 +150,7 @@ class Request:
         self.deadline = deadline
         self.priority = int(priority)
         self.stale = stale
+        self.trace = trace
 
     @property
     def _watched(self) -> bool:

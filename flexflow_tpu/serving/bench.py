@@ -474,6 +474,60 @@ def run_serve_bench(requests: int = 512, rows_lo: int = 1, rows_hi: int = 8,
     }
 
 
+_TRACE_COUNTERS = {
+    "submitted": "ff_serve_submitted_total",
+    "completed": "ff_serve_requests_total",
+    "rejected": "ff_serve_rejected_total",
+    "shed": "ff_serve_shed_total",
+    "expired": "ff_serve_expired_total",
+    "error": "ff_serve_errors_total",
+    "cancelled": "ff_serve_cancelled_total",
+}
+
+
+def _registry_totals() -> Dict[str, int]:
+    """Whole-process sums of the serving lifetime counters (all engine
+    generations) — the baseline/endpoint of the trace reconciliation."""
+    from ..obs.registry import get_registry
+    fams = {f.name: f for f in get_registry().families()}
+    return {k: int(fams[n].total()) if n in fams else 0
+            for k, n in _TRACE_COUNTERS.items()}
+
+
+def _finish_trace(tracer, path: str, counters0: Dict[str, int]) -> Dict:
+    """Save the raw trace and reconcile it: every request submitted
+    during the run must have produced exactly ONE terminal `request`
+    span whose phase matches the engine counters
+    (``submitted == completed+rejected+shed+expired+errors+cancelled``,
+    per-phase equality).  The payload's `trace` section is the
+    acceptance evidence; `sample_trace_ids` lets a reader pull those
+    requests' full timelines out of the exported Chrome trace."""
+    raw = tracer.save(path)
+    phases = tracer.terminal_phase_counts()
+    counters = {k: v - counters0.get(k, 0)
+                for k, v in _registry_totals().items()}
+    per_phase_ok = all(
+        phases.get(ph, 0) == counters.get(ph, 0)
+        for ph in ("completed", "rejected", "shed", "expired", "error",
+                   "cancelled"))
+    reconciled = (per_phase_ok
+                  and sum(phases.values()) == counters["submitted"]
+                  and raw.get("dropped", 0) == 0)
+    sample_ids = [s["trace"] for s in raw["spans"]
+                  if s["name"] == "request"][:4]
+    tracer.disable()
+    return {
+        "file": path,
+        "schema": raw["schema"],
+        "spans": len(raw["spans"]),
+        "dropped": raw.get("dropped", 0),
+        "terminal_phases": phases,
+        "counters": counters,
+        "reconciled": bool(reconciled),
+        "sample_trace_ids": sample_ids,
+    }
+
+
 def main(argv=None) -> None:
     argv = list(sys.argv[1:] if argv is None else argv)
     if "--generate" in argv:
@@ -532,6 +586,18 @@ def main(argv=None) -> None:
                          "the measured run)")
     ap.add_argument("--out", default="",
                     help="also write the JSON artifact here")
+    ap.add_argument("--trace-out", default="",
+                    help="enable span tracing at sample_rate=1.0 for "
+                         "the whole run and write the raw ff-trace-v1 "
+                         "file here (export with `flexflow-tpu trace "
+                         "export`); the payload gains a `trace` section "
+                         "reconciling terminal span counts EXACTLY "
+                         "against the engine counters "
+                         "(docs/observability.md)")
+    ap.add_argument("--prom-out", default="",
+                    help="write the process metrics registry's "
+                         "Prometheus text exposition here after the "
+                         "run (what GET /metrics would have served)")
     args = ap.parse_args(argv)
     try:
         lo, hi = (int(v) for v in args.rows.split("-"))
@@ -550,6 +616,14 @@ def main(argv=None) -> None:
         except (OSError, ValueError) as e:
             ap.error(f"cannot load --calibration {args.calibration!r}: {e}")
 
+    tracer = None
+    counters0 = {}
+    if args.trace_out:
+        from ..obs.trace import get_tracer
+        tracer = get_tracer().configure(sample_rate=1.0,
+                                        capacity=1 << 20)
+        tracer.reset()
+        counters0 = _registry_totals()
     # this bench's stdout IS the payload: silence the serve_stats /
     # epoch event streams while measuring (restored after)
     from ..fflogger import silenced
@@ -581,12 +655,23 @@ def main(argv=None) -> None:
                 buckets=args.buckets, hidden=args.hidden, seed=args.seed,
                 burst=args.burst, rate_frac=args.rate_frac)
     payload["calibration_digest"] = digest
+    if tracer is not None:
+        payload["trace"] = _finish_trace(tracer, args.trace_out,
+                                         counters0)
+        print(f"# wrote {args.trace_out} "
+              f"({payload['trace']['spans']} spans, reconciled="
+              f"{payload['trace']['reconciled']})", file=sys.stderr)
     text = json.dumps(payload, indent=2)
     print(text)
     if args.out:
         with open(args.out, "w") as f:
             f.write(text + "\n")
         print(f"# wrote {args.out}", file=sys.stderr)
+    if args.prom_out:
+        from ..obs.registry import render_prometheus
+        with open(args.prom_out, "w") as f:
+            f.write(render_prometheus())
+        print(f"# wrote {args.prom_out}", file=sys.stderr)
 
 
 if __name__ == "__main__":

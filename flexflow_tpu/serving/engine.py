@@ -45,6 +45,8 @@ import numpy as np
 from .. import faults
 from ..compile_cache import enable as _enable_compile_cache
 from ..fflogger import get_logger
+from ..obs.flight import flight_dump, get_flight
+from ..obs.trace import phase_of, tracer_from_config
 from .batcher import (ADMISSION_POLICIES, MicroBatcher, Request, bucket_for,
                       derive_buckets, split_sizes)
 from .errors import OverloadError, SheddedError
@@ -87,13 +89,17 @@ class _Join:
     logical request fails once and no orphan chunk burns a dispatch."""
 
     def __init__(self, future: Future, nparts: int, t_submit: float,
-                 metrics: ServingMetrics, deadlined: bool = False):
+                 metrics: ServingMetrics, deadlined: bool = False,
+                 trace_done: Optional[Callable] = None):
         self.future = future
         self.parts: list = [None] * nparts
         self.missing = nparts
         self.t_submit = t_submit
         self.metrics = metrics
         self.deadlined = deadlined
+        # trace_done(phase, now): records the logical request's ONE
+        # terminal span (None when the request was not sampled)
+        self.trace_done = trace_done
         self.lock = threading.Lock()
 
     def part(self, i: int) -> Callable:
@@ -112,6 +118,8 @@ class _Join:
             if isinstance(out, BaseException):
                 if _resolve_future(self.future, out):
                     self.metrics.record_failure(out)
+                    if self.trace_done is not None:
+                        self.trace_done(phase_of(out), now)
                     return True
                 return False
             self.parts[i] = out
@@ -122,6 +130,8 @@ class _Join:
                            np.concatenate(self.parts, axis=0)):
             self.metrics.record_request(now - self.t_submit,
                                         deadlined=self.deadlined)
+            if self.trace_done is not None:
+                self.trace_done("completed", now)
             return True
         return False
 
@@ -195,6 +205,13 @@ class ServingEngine:
             window_s=metrics_window_s, clock=clock,
             queue_depth_fn=lambda: self._batcher.queue_depth,
             model=self.name)
+        # observability plane (docs/observability.md): the tracer's
+        # `active` bool is the ONE lock-free check the dispatch hot
+        # path reads when tracing is off; get_flight() installs the
+        # passive event/span taps so a post-mortem dump covers this
+        # engine's whole lifetime
+        self._tracer = tracer_from_config(cfg)
+        get_flight()
         self._n_inputs = len(model.input_tensors)
         self._in_dtypes = [t.dtype for t in model.input_tensors]
         self._in_shapes = [tuple(t.shape[1:]) for t in model.input_tensors]
@@ -279,6 +296,14 @@ class ServingEngine:
                 consec_errors=self._consec_errors,
                 drop_rate=round(rate, 4), window_submitted=submitted,
                 queue_depth=self._batcher.queue_depth)
+        if state == "degraded":
+            # a health edge INTO degraded is a flight-recorder trigger
+            # (docs/observability.md): the ring holds the events/spans
+            # that led here.  Outside the health lock — dump I/O must
+            # never serialize health ticks.
+            flight_dump("health_degraded",
+                        extra={"model": self.name, "prev": prev,
+                               "drop_rate": round(rate, 4)})
 
     # ---- lifecycle -----------------------------------------------------
     def start(self) -> "ServingEngine":
@@ -344,6 +369,10 @@ class ServingEngine:
                     for r in reqs:
                         r.on_done(err, now)
         self._health_tick()
+        # retire the live registry hooks: a stopped engine must not be
+        # retained by the process-global registry (fleet swaps, bench
+        # legs — counters stay readable, the gauge provider drops)
+        self.metrics.release()
         self._shutdown_done.set()
 
     def drain(self, timeout: Optional[float] = None) -> Dict:
@@ -423,6 +452,7 @@ class ServingEngine:
                                      "max_batch": self.max_batch,
                                      "health": "stopped",
                                      "drain_shed": shed})
+        self.metrics.release()
         self._shutdown_done.set()
         return snap
 
@@ -492,26 +522,46 @@ class ServingEngine:
         deadline = None if deadline_ms is None else t0 + deadline_ms / 1e3
         self.metrics.record_submitted()
         metrics = self.metrics
+        # span tracing (docs/observability.md): one trace id per
+        # sampled logical request; trace_done records its ONE terminal
+        # `request` span — phase names the outcome, and the per-phase
+        # span counts reconcile exactly with the metrics counters
+        tr = self._tracer
+        trace = tr.new_trace() if tr.active else None
+        trace_done = None
+        if trace is not None:
+            tname = self.name or "serve"
+
+            def trace_done(phase: str, now: float,
+                           _t=trace, _n=n) -> None:
+                tr.span("request", _t, t0, now, tid=tname,
+                        phase=phase, rows=_n, model=self.name)
         sizes = split_sizes(n, self.max_batch)
         if len(sizes) == 1:
             deadlined = deadline is not None
+            done_trace = trace_done
 
             def on_done(out, now: float) -> bool:
                 if isinstance(out, BaseException):
                     if _resolve_future(fut, out):
                         metrics.record_failure(out)
+                        if done_trace is not None:
+                            done_trace(phase_of(out), now)
                         return True
                     return False
                 if _resolve_future(fut, out):
                     metrics.record_request(now - t0, deadlined=deadlined)
+                    if done_trace is not None:
+                        done_trace("completed", now)
                     return True
                 return False
 
             reqs = [Request(arrs, n, on_done, t0, deadline=deadline,
-                            priority=priority)]
+                            priority=priority, trace=trace)]
         else:
             join = _Join(fut, len(sizes), t0, self.metrics,
-                         deadlined=deadline is not None)
+                         deadlined=deadline is not None,
+                         trace_done=trace_done)
             reqs = []
             off = 0
             for i, sz in enumerate(sizes):
@@ -521,7 +571,7 @@ class ServingEngine:
                 # them before packing (atomic expiry/cancel)
                 reqs.append(Request(chunk, sz, join.part(i), t0,
                                     deadline=deadline, priority=priority,
-                                    stale=fut.done))
+                                    stale=fut.done, trace=trace))
                 off += sz
         try:
             # atomic: all chunks or none (a concurrent stop() must not
@@ -530,6 +580,8 @@ class ServingEngine:
             blocked_s = self._batcher.submit_all(reqs)
         except OverloadError:
             self.metrics.record_rejected()
+            if trace_done is not None:
+                trace_done("rejected", self.clock())
             self._health_tick()
             raise
         except RuntimeError as e:
@@ -542,10 +594,31 @@ class ServingEngine:
             # submitted == requests+rejected+shed+expired+errors
             # reconciliation serve-bench pins
             self.metrics.record_rejected()
+            if trace_done is not None:
+                trace_done("rejected", self.clock())
             raise OverloadError(
                 f"engine is not admitting new work ({e})") from e
         if blocked_s > 0:
             self.metrics.record_blocked(blocked_s)
+            if trace is not None:
+                tr.span("admission_wait", trace, t0, t0 + blocked_s,
+                        tid=self.name or "serve")
+
+        def count_cancel(f, _done=trace_done):
+            # a client cancel() while queued succeeds without any
+            # resolution path ever running (a cancelled future cannot
+            # be completed; stale split chunks are even reaped
+            # silently) — count the submitted request's outcome HERE,
+            # at the cancel instant, or the submitted == outcomes
+            # reconciliation (and its terminal-span mirror) leaks one
+            # per cancel.  Future.cancel() succeeds at most once, so
+            # this fires at most once with cancelled()=True.
+            if f.cancelled():
+                metrics.record_cancelled()
+                if _done is not None:
+                    _done("cancelled", self.clock())
+
+        fut.add_done_callback(count_cancel)
         return fut
 
     def stats(self) -> Dict:
@@ -698,9 +771,18 @@ class ServingEngine:
             # as a counter clients discover via exceptions
             get_logger("serve").event(
                 "serve_dispatch_error", model=self.name,
+                dispatch=self._n_dispatch,
                 error=f"{type(e).__name__}: {e}"[:300],
                 failed_requests=failed,
                 errors_total=self.metrics.total_errors)
+            # post-mortem: the flight ring now holds this dispatch's
+            # request spans + the error event — dump it (no-op unless
+            # FF_FLIGHT_DIR is set; rate-limited under storms)
+            flight_dump("serve_dispatch_error",
+                        extra={"model": self.name,
+                               "dispatch": self._n_dispatch,
+                               "error": f"{type(e).__name__}: {e}"[:300],
+                               "failed_requests": failed})
             self._health_tick()
 
     def _dispatch_batch(self, reqs) -> None:
@@ -710,6 +792,10 @@ class ServingEngine:
         rows = sum(r.n for r in reqs)
         bucket = bucket_for(rows, self.buckets)
         depth = self._batcher.queue_depth
+        # the ONE tracing check on the dispatch hot path: a single
+        # lock-free bool read; everything below keys off the local
+        tr = self._tracer
+        traced = tr.active
         t0 = self.clock()
         packed = []
         for j in range(self._n_inputs):
@@ -729,8 +815,10 @@ class ServingEngine:
         # executable lowered from the old graph would silently diverge
         # from predict()
         fwd = model.forward_compiled(bucket)
+        t_pack = self.clock() if traced else 0.0
         with jax.profiler.StepTraceAnnotation("serve", step_num=idx):
             out = fwd(model._params, batch)
+            t_exec = self.clock() if traced else 0.0
             # the ONE host fetch for the whole packed batch — per-request
             # outputs are sliced from it below (RL005 bans any host sync
             # inside the scatter loop)
@@ -755,6 +843,26 @@ class ServingEngine:
             # request's rows
             r.on_done(host[off:off + r.n].copy(), now)
             off += r.n
+        if traced:
+            t_scatter = self.clock()
+            tname = self.name or "serve"
+            # per-request: the time each sampled request sat coalescing
+            # in the micro-batcher (submit -> packed into this dispatch)
+            for r in reqs:
+                if r.trace is not None:
+                    tr.span("queue", r.trace, r.t_submit, t0, tid=tname,
+                            dispatch=idx)
+            # dispatch-scope: the pack/dispatch/fetch/scatter quartet
+            # (trace=None — they belong to the packed batch, whose
+            # member trace ids ride in args)
+            traces = [r.trace for r in reqs if r.trace is not None]
+            tr.span("pack", None, t0, t_pack, tid=tname, dispatch=idx,
+                    rows=rows, bucket=bucket, requests=len(reqs))
+            tr.span("dispatch", None, t_pack, t_exec, tid=tname,
+                    dispatch=idx, bucket=bucket, traces=traces)
+            tr.span("fetch", None, t_exec, now, tid=tname, dispatch=idx)
+            tr.span("scatter", None, now, t_scatter, tid=tname,
+                    dispatch=idx)
         if self.stats_every and self._n_dispatch % self.stats_every == 0:
             self.metrics.emit(extra={"max_batch": self.max_batch,
                                      "health": self.health})

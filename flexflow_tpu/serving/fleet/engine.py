@@ -51,11 +51,25 @@ from ..generation.engine import GenerationEngine
 from .registry import ModelRegistry, TenantSpec, build_model
 
 
+# counter continuity across hot swaps: the lifetime keys summed over
+# every engine generation that served under one tenant name
+_CONTINUITY_KEYS = ("dispatches", "requests", "rows", "errors",
+                    "rejected", "shed", "expired", "cancelled",
+                    "submitted")
+# LIVE retired-generation metrics kept per tenant before the oldest is
+# folded into the static carry and its registry series reclaimed: a
+# generation this many swaps old has drained its transferred requests
+# (each swap's moved queue resolves within the NEXT generation's
+# serving period), so the fold is exact in practice while a week of
+# hot swaps stays bounded in registry memory and /metrics payload
+_MAX_RETIRED_METRICS = 4
+
+
 class _Tenant:
     """Dispatcher-side state of one resident model."""
 
     __slots__ = ("name", "kind", "engine", "weight", "qps_rows", "vtime",
-                 "allowance", "last_refill", "idle", "retired")
+                 "allowance", "last_refill", "idle", "retired", "carried")
 
     def __init__(self, name: str, kind: str, engine, weight: float,
                  qps_rows: float, now: float):
@@ -72,8 +86,11 @@ class _Tenant:
         # objects, not snapshots: a request transferred across the
         # swap resolves on the NEW engine but records into the metrics
         # its submit() closure captured — the OLD one — so counter
-        # continuity needs the object, not a copy taken at swap time
+        # continuity needs the object, not a copy taken at swap time.
+        # Bounded: beyond _MAX_RETIRED_METRICS generations the oldest
+        # is folded into `carried` (static sums) and unregistered.
         self.retired: List = []
+        self.carried: Dict[str, float] = {}
 
     def has_pending(self) -> bool:
         return self.engine.has_pending
@@ -184,6 +201,38 @@ class FleetEngine:
         # to exactly that)
         self._vclock = 0.0       # dispatcher-thread-only
         self._swap_hold = self._swap_hold_n()
+        # observability plane: per-tenant fairness gauges + the fleet
+        # dispatch counter live in the obs.registry (what fleet_stats
+        # events report and /metrics exposes), and the flight-recorder
+        # taps are installed so a fleet post-mortem covers every tenant
+        from ...obs.flight import get_flight
+        from ...obs.registry import get_registry
+        from ..metrics import next_engine_id
+        get_flight()
+        reg = get_registry()
+        # eng label = this fleet's own generation id (same sequence as
+        # the per-engine metrics): two FleetEngines in one process —
+        # sequential bench legs, a rebuilt fleet after drain — must
+        # never merge their dispatch counts or overwrite each other's
+        # tenant vtime gauges
+        self._fleet_eng = next_engine_id()
+        self._g_vtime = reg.gauge(
+            "ff_fleet_vtime_seconds",
+            "Per-tenant virtual device time (used seconds / weight)",
+            ("model", "eng"))
+        self._c_dispatch = reg.counter(
+            "ff_fleet_dispatches_total",
+            "Fleet dispatcher packed dispatches across all tenants",
+            ("eng",)).labels(eng=self._fleet_eng)
+        # per-tenant vtime gauge children, resolved once per tenant —
+        # the dispatch loop must not re-run label validation + the
+        # family lock per packed dispatch
+        self._vtime_children: Dict = {}  # dispatcher-thread-only
+        # tenant names whose vtime series the DISPATCHER must reclaim
+        # (unload() queues them here: reclaiming from the caller
+        # thread raced an in-flight dispatch, whose completion
+        # re-created the just-removed series)
+        self._vtime_reclaim: List[str] = []  # guarded_by: self._lock
 
     @staticmethod
     def _swap_hold_n() -> Optional[int]:
@@ -400,7 +449,30 @@ class FleetEngine:
                 tenant.engine._batcher.requeue(moved)
             tenant.vtime = old.vtime
             tenant.idle = False
+            tenant.carried = dict(old.carried)
             tenant.retired = old.retired + [old.engine.metrics]
+            while len(tenant.retired) > _MAX_RETIRED_METRICS:
+                # fold the OLDEST retired generation into the static
+                # carry and reclaim its registry series — by now its
+                # transferred requests have long resolved, so the
+                # fold loses nothing while bounding registry growth.
+                # The folded counts MOVE into the tenant's eng="carry"
+                # series (inc BEFORE removal — a scrape in the window
+                # sees a brief double-count, never a backwards counter
+                # that Prometheus rate() would read as a reset), so
+                # the scraped per-model sums stay monotonic and equal
+                # to fleet.stats()'s continuity numbers
+                oldest = tenant.retired.pop(0)
+                snap = oldest.snapshot()
+                for key in _CONTINUITY_KEYS:
+                    v = snap.get(key, 0)
+                    tenant.carried[key] = (tenant.carried.get(key, 0)
+                                           + v)
+                    if v:
+                        oldest._fams[key].labels(
+                            model=oldest.model_tag,
+                            eng="carry").inc(v)
+                oldest.unregister()
             if old.kind == "generation" and old.engine.has_pending:
                 # active decode slots cannot move (their KV state
                 # lives in the old engine's cache): keep stepping the
@@ -440,6 +512,14 @@ class FleetEngine:
             t.engine._abort_active()
         t.engine.stop()  # fails any stragglers with SheddedError
         snap = self._tenant_stats(t)
+        # queue the unloaded tenant's fleet gauge series for the
+        # DISPATCHER to reclaim at its next boundary (its own engine
+        # series were released by stop()): removing it here raced the
+        # tenant's possibly-still-in-flight last dispatch, which would
+        # re-create — and permanently resurrect — the stale series
+        with self._lock:
+            self._vtime_reclaim.append(name)
+        self._wake.set()
         get_logger("serve").event("fleet_unload", model=name,
                                   pending_failed=int(t.has_pending()))
         return snap
@@ -475,10 +555,12 @@ class FleetEngine:
         # served under its name — read LIVE from the retired metrics
         # (see _Tenant.retired) so the reconciliation serve-bench pins
         # holds even for requests that resolved after their swap
+        for key, v in t.carried.items():
+            if key in snap:
+                snap[key] += v
         for m in t.retired:
             old = m.snapshot()
-            for key in ("dispatches", "requests", "rows", "errors",
-                        "rejected", "shed", "expired", "cancelled"):
+            for key in _CONTINUITY_KEYS:
                 if key in snap and key in old:
                     snap[key] += old[key]
         snap.update({"weight": t.weight, "qps_rows_budget": t.qps_rows,
@@ -502,6 +584,7 @@ class FleetEngine:
     def _dispatch_loop(self) -> None:
         while True:
             self._do_publishes()
+            self._do_vtime_reclaims()
             self._finalize_retiring()
             with self._lock:
                 draining = self._draining
@@ -535,11 +618,20 @@ class FleetEngine:
                 continue
             t = served
             self._n_dispatch += 1
+            self._c_dispatch.inc()
             with self._lock:
                 t.vtime += dt / t.weight
                 if t.qps_rows > 0:
                     t.allowance -= (t.engine.metrics.total_rows - rows0)
             self._vclock = t.vtime
+            # the registry's view of the fairness state fleet_stats
+            # reports — same number, two surfaces
+            child = self._vtime_children.get(t.name)
+            if child is None:
+                child = self._g_vtime.labels(model=t.name,
+                                             eng=self._fleet_eng)
+                self._vtime_children[t.name] = child
+            child.set(t.vtime)
             self._maybe_emit_stats()
 
     def _pick_order(self, tenants: List[_Tenant]) -> List[_Tenant]:
@@ -563,6 +655,18 @@ class FleetEngine:
                 ready.append(t)
         ready.sort(key=lambda t: (t.vtime, t.name))
         return ready
+
+    def _do_vtime_reclaims(self) -> None:
+        """Drop unloaded tenants' vtime gauge series (dispatcher
+        thread — after this point no dispatch can re-create them: the
+        tenant left ``_tenants`` before its name was queued here)."""
+        with self._lock:
+            if not self._vtime_reclaim:
+                return
+            names, self._vtime_reclaim = self._vtime_reclaim, []
+        for name in names:
+            self._vtime_children.pop(name, None)
+            self._g_vtime.remove(model=name, eng=self._fleet_eng)
 
     def _finalize_retiring(self) -> None:
         """Stop swapped-out generation engines whose last active

@@ -44,6 +44,8 @@ import numpy as np
 from ... import faults
 from ...compile_cache import enable as _enable_compile_cache
 from ...fflogger import get_logger
+from ...obs.flight import flight_dump, get_flight
+from ...obs.trace import phase_of, tracer_from_config
 from ...profiling import quantiles
 from ..batcher import MicroBatcher, Request
 from ..errors import GenerationCancelled, OverloadError, SheddedError
@@ -91,12 +93,15 @@ class GenerationStream:
     already iterated remain valid."""
 
     def __init__(self, prompt_len: int, max_new: int, t_submit: float,
-                 deadlined: bool = False):
+                 deadlined: bool = False, trace: Optional[str] = None):
         self.future: Future = Future()
         self.prompt_len = int(prompt_len)
         self.max_new = int(max_new)
         self.t_submit = t_submit
         self.deadlined = deadlined
+        # sampled trace id (obs.trace) or None; the engine records this
+        # stream's queue/prefill/terminal spans against it
+        self.trace = trace
         self._q: "queue.SimpleQueue" = queue.SimpleQueue()
         self._tokens: List[int] = []  # engine-thread writes, then frozen
         self._cancelled = threading.Event()
@@ -202,20 +207,42 @@ class GenerationMetrics(ServingMetrics):
         super().__init__(**kw)
         self._ttfts: deque = deque(maxlen=4096)  # guarded_by: self._lock
         self._steps: deque = deque()             # guarded_by: self._lock
-        self.total_tokens = 0                    # guarded_by: self._lock
-        self.total_prefills = 0                  # guarded_by: self._lock
+        # token/prefill lifetime totals live in the obs.registry like
+        # every other serving counter — gen_stats events and /metrics
+        # read the same children (docs/observability.md "Metrics")
+        from ...obs.registry import get_registry
+        reg = get_registry()
+        kv = {"model": self.model_tag, "eng": self.eng_id}
+        # into self._fams too: unregister() must reclaim these series
+        # with the rest (the fleet's bounded-retirement scheme)
+        self._fams["tokens"] = reg.counter(
+            "ff_gen_tokens_total", "Tokens generated (incl. the "
+            "prefill's first token)", ("model", "eng"))
+        self._fams["prefills"] = reg.counter(
+            "ff_gen_prefills_total", "Prefill dispatches (stream "
+            "joins)", ("model", "eng"))
+        self._ctr["tokens"] = self._fams["tokens"].labels(**kv)
+        self._ctr["prefills"] = self._fams["prefills"].labels(**kv)
+
+    @property
+    def total_tokens(self) -> int:
+        return int(self._ctr["tokens"].value)
+
+    @property
+    def total_prefills(self) -> int:
+        return int(self._ctr["prefills"].value)
 
     def record_ttft(self, seconds: float) -> None:
         now = self.clock()
+        self._ctr["prefills"].inc()
         with self._lock:
             self._ttfts.append((now, float(seconds)))
-            self.total_prefills += 1
 
     def record_decode_step(self, ntokens: int, step_s: float) -> None:
         now = self.clock()
+        self._ctr["tokens"].inc(int(ntokens))
         with self._lock:
             self._steps.append((now, int(ntokens), float(step_s)))
-            self.total_tokens += int(ntokens)
             horizon = now - self.window_s
             while self._steps and self._steps[0][0] < horizon:
                 self._steps.popleft()
@@ -223,9 +250,9 @@ class GenerationMetrics(ServingMetrics):
     def record_prefill_token(self) -> None:
         """The prefill's first token counts toward tokens/s too."""
         now = self.clock()
+        self._ctr["tokens"].inc()
         with self._lock:
             self._steps.append((now, 1, 0.0))
-            self.total_tokens += 1
             # trim here too: a max_new_tokens=1 workload never calls
             # record_decode_step, and the window must stay bounded
             horizon = now - self.window_s
@@ -329,6 +356,11 @@ class GenerationEngine:
             window_s=metrics_window_s, clock=clock,
             queue_depth_fn=lambda: self._batcher.queue_depth,
             model=self.name)
+        # observability plane: same contract as ServingEngine — one
+        # lock-free `active` read per decode step when tracing is off,
+        # flight taps installed for post-mortem dumps
+        self._tracer = tracer_from_config(cfg)
+        get_flight()
         self._decoder = GraphDecoder.for_model(model, self.slots,
                                                self.max_seq)
         # the ONE KV accounting (analysis.kv_memory): what lint's
@@ -425,6 +457,8 @@ class GenerationEngine:
                 for r in self._batcher.fail_pending():
                     r.on_done(err, now)
             self._stopped = True
+        # same registry retirement as ServingEngine.stop()
+        self.metrics.release()
         self._shutdown_done.set()
 
     def drain(self, timeout: Optional[float] = None) -> Dict:
@@ -474,6 +508,7 @@ class GenerationEngine:
         if first:
             self.metrics.emit(extra={"final": True, "slots": self.slots,
                                      "drain_shed": shed})
+        self.metrics.release()
         self._shutdown_done.set()
         return snap
 
@@ -571,10 +606,14 @@ class GenerationEngine:
                 f"exceeds the KV cache length max_seq={self.max_seq}")
         t0 = self.clock()
         self.metrics.record_submitted()
+        tr = self._tracer
+        trace = tr.new_trace() if tr.active else None
         stream = GenerationStream(arr.size, max_new, t0,
-                                  deadlined=deadline_ms is not None)
+                                  deadlined=deadline_ms is not None,
+                                  trace=trace)
         deadline = None if deadline_ms is None else t0 + deadline_ms / 1e3
         metrics = self.metrics
+        trace_term = self._trace_terminal
 
         def on_done(out, now: float) -> bool:
             # failure-path resolution only (expiry/shed/drain/stop);
@@ -582,21 +621,52 @@ class GenerationEngine:
             if isinstance(out, BaseException):
                 if stream._fail(out):
                     metrics.record_failure(out)
+                    trace_term(stream, phase_of(out), now)
                     return True
             return False
 
         req = _GenRequest(stream, arr.copy(), on_done, t0,
                           deadline=deadline, priority=priority)
+        req.trace = trace
+
+        def count_cancel(f):
+            # a cancel-while-QUEUED succeeds on the pending future and
+            # no resolution path ever runs for it (the join claim just
+            # drops the request) — count the submitted stream's
+            # outcome at the cancel instant, or the submitted ==
+            # outcomes reconciliation leaks one per cancel.  A
+            # mid-generation cancel cannot reach here with
+            # cancelled()=True (cancel() on a RUNNING future fails;
+            # _retire counts it via record_failure instead).
+            if f.cancelled():
+                metrics.record_cancelled()
+                trace_term(stream, "cancelled", self.clock())
+
+        stream.future.add_done_callback(count_cancel)
         try:
             self._batcher.submit(req)
         except OverloadError:
             self.metrics.record_rejected()
+            self._trace_terminal(stream, "rejected", self.clock())
             raise
         except RuntimeError as e:
             self.metrics.record_rejected()
+            self._trace_terminal(stream, "rejected", self.clock())
             raise OverloadError(
                 f"engine is not admitting new work ({e})") from e
         return stream
+
+    def _trace_terminal(self, stream: GenerationStream, phase: str,
+                        now: float) -> None:
+        """Record the stream's ONE terminal `request` span (no-op for
+        unsampled streams) — phase counts reconcile with the metrics
+        counters exactly like the dense engine's."""
+        if stream.trace is None:
+            return
+        self._tracer.span(
+            "request", stream.trace, stream.t_submit, now,
+            tid=self.name or "generate", phase=phase,
+            tokens=len(stream._tokens), model=self.name)
 
     def stats(self) -> Dict:
         active = sum(1 for s in self._slots_state if s is not None)
@@ -668,8 +738,11 @@ class GenerationEngine:
         except RuntimeError:
             claimed = False
         if not claimed:
-            return  # cancelled/expired while queued
+            return  # cancelled/expired while queued (the cancel was
+            #         counted at cancel() time — see submit())
         prompt = req.xs[0]
+        traced = self._tracer.active
+        t_join = self.clock() if traced else 0.0
         try:
             bucket = self._decoder.prefill_bucket(prompt.size)
             tokens = np.zeros((1, bucket), np.int32)
@@ -689,6 +762,7 @@ class GenerationEngine:
             # stream; the engine re-arms and keeps serving the queue
             if stream._fail(e):
                 self.metrics.record_failure(e)
+                self._trace_terminal(stream, "error", self.clock())
             self._recover_from_dispatch_error(e, "gen_prefill_error")
             return
         now = self.clock()
@@ -698,6 +772,13 @@ class GenerationEngine:
         stream._emit(tok)
         self.metrics.record_ttft(stream.ttft)
         self.metrics.record_prefill_token()
+        if traced and stream.trace is not None:
+            tname = self.name or "generate"
+            self._tracer.span("queue", stream.trace, stream.t_submit,
+                              t_join, tid=tname, slot=slot)
+            self._tracer.span("prefill", stream.trace, t_join, now,
+                              tid=tname, slot=slot, bucket=bucket,
+                              prompt_len=int(prompt.size))
         self._retire(slot, st, now)
 
     def _decode_once(self) -> None:
@@ -712,6 +793,9 @@ class GenerationEngine:
                 pos[i] = s.length
                 nactive += 1
         fn = self._decoder.decode_fn()
+        # ONE lock-free tracing check per decode step (hot-path
+        # contract, docs/observability.md)
+        traced = self._tracer.active
         t0 = self.clock()
         with jax.profiler.StepTraceAnnotation("generate",
                                               step_num=self._n_steps):
@@ -731,6 +815,10 @@ class GenerationEngine:
             s.last_token = tok
             s.stream._emit(tok)
             self._retire(i, s, now)
+        if traced:
+            self._tracer.span("decode_step", None, t0, now,
+                              tid=self.name or "generate",
+                              step=self._n_steps - 1, active=nactive)
         self.metrics.record_decode_step(nactive, now - t0)
         self._fire_cancel_at_token(now)
         if self.stats_every and self._n_steps % self.stats_every == 0:
@@ -748,18 +836,28 @@ class GenerationEngine:
         engine recovers; a poisoned dispatch must never wedge it on
         'Array has been deleted' forever)."""
         failed = 0
+        now = self.clock()
         for i, s in enumerate(self._slots_state):
             if s is None:
                 continue
             if s.stream._fail(e):
                 self.metrics.record_failure(e)
+                self._trace_terminal(s.stream, "error", now)
                 failed += 1
             self._slots_state[i] = None
         self._caches = self._decoder.init_cache()
-        get_logger("serve").event(
-            event, model=self.name,
+        get_logger("serve").event(  # RL011-ok: gen_decode_error |
+            # gen_prefill_error, both declared in obs/events.py —
+            # callers pass the literal
+            event, model=self.name, step=self._n_steps,
             error=f"{type(e).__name__}: {e}"[:300],
             failed_streams=failed)
+        # generation's dispatch-error flight trigger (no-op unless
+        # FF_FLIGHT_DIR is set)
+        flight_dump(event, extra={"model": self.name,
+                                  "step": self._n_steps,
+                                  "error": f"{type(e).__name__}: {e}"[:300],
+                                  "failed_streams": failed})
 
     def _retire(self, slot: int, s: _Slot, now: float) -> None:
         """Free the slot if its stream finished or was cancelled —
@@ -771,6 +869,7 @@ class GenerationEngine:
                 f"KV slot {slot} freed")
             if s.stream._fail(exc):
                 self.metrics.record_failure(exc)
+                self._trace_terminal(s.stream, "cancelled", now)
             self._slots_state[slot] = None
             return
         done = s.generated >= s.stream.max_new or (
@@ -779,10 +878,12 @@ class GenerationEngine:
             if s.stream._finish():
                 self.metrics.record_request(now - s.stream.t_submit,
                                             deadlined=s.stream.deadlined)
+                self._trace_terminal(s.stream, "completed", now)
             self._slots_state[slot] = None
 
     def _abort_active(self) -> None:
         """drain(timeout) expired: shed whatever is still decoding."""
+        now = self.clock()
         for i, s in enumerate(self._slots_state):
             if s is None:
                 continue
@@ -790,6 +891,7 @@ class GenerationEngine:
                 "engine drained mid-generation (drain timeout)")
             if s.stream._fail(exc):
                 self.metrics.record_failure(exc)
+                self._trace_terminal(s.stream, "shed", now)
             self._slots_state[i] = None
 
     # ---- fault injection (FF_FAULT generation kinds) -------------------

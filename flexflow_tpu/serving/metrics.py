@@ -27,8 +27,81 @@ from collections import deque
 from typing import Callable, Dict, Optional, Tuple
 
 from ..fflogger import get_logger
+from ..obs.registry import get_registry
+from ..obs.trace import phase_of
 from ..profiling import quantiles
-from .errors import DeadlineExceeded, GenerationCancelled, SheddedError
+
+# per-process engine-generation sequence: the ``eng`` label that keeps
+# two engines serving the SAME model name (bench legs, a fleet swap's
+# old/new generation) from merging their registry counters
+_ENG_SEQ = [0]
+_ENG_LOCK = threading.Lock()
+
+
+def next_engine_id() -> str:
+    """Draw the next per-process ``eng`` label value (also used by the
+    FleetEngine for its fleet-scoped families — one sequence, so any
+    engine-shaped thing in the process gets a unique generation id)."""
+    with _ENG_LOCK:
+        _ENG_SEQ[0] += 1
+        return str(_ENG_SEQ[0])
+
+
+def _lifetime_counters(model_tag: str):
+    """Declare (idempotently) the serving counter families and return
+    this engine's children.  These ARE the lifetime counters: the
+    ``serve_stats`` event stream and ``stats()`` snapshots read them
+    back, so the JSON events and the Prometheus ``/metrics`` exposition
+    are views over one set of numbers and cannot diverge
+    (docs/observability.md "Metrics")."""
+    reg = get_registry()
+    labels = ("model", "eng")
+    eng = next_engine_id()
+    kv = {"model": model_tag, "eng": eng}
+    fams = {
+        "submitted": reg.counter(
+            "ff_serve_submitted_total",
+            "Logical requests entering submit(), admitted or not",
+            labels),
+        "requests": reg.counter(
+            "ff_serve_requests_total",
+            "Logical requests completed successfully", labels),
+        "rows": reg.counter(
+            "ff_serve_rows_total", "Rows dispatched to the device",
+            labels),
+        "dispatches": reg.counter(
+            "ff_serve_dispatches_total", "Packed device dispatches",
+            labels),
+        "errors": reg.counter(
+            "ff_serve_errors_total",
+            "Logical requests failed by dispatch errors", labels),
+        "rejected": reg.counter(
+            "ff_serve_rejected_total",
+            "Requests refused at admission (OverloadError)", labels),
+        "shed": reg.counter(
+            "ff_serve_shed_total",
+            "Queued requests evicted under overload (SheddedError)",
+            labels),
+        "expired": reg.counter(
+            "ff_serve_expired_total",
+            "Queued requests past their deadline (DeadlineExceeded)",
+            labels),
+        "cancelled": reg.counter(
+            "ff_serve_cancelled_total",
+            "Streams cancelled by the client (GenerationCancelled)",
+            labels),
+        "blocked_s": reg.counter(
+            "ff_serve_admission_blocked_seconds_total",
+            "Producer seconds spent blocked for admission", labels),
+    }
+    fams["latency"] = reg.histogram(
+        "ff_serve_latency_seconds",
+        "Logical request latency, submit to resolution", labels)
+    fams["queue_depth"] = reg.gauge(
+        "ff_serve_queue_depth",
+        "Live pending requests in the micro-batcher", labels)
+    children = {k: fam.labels(**kv) for k, fam in fams.items()}
+    return children, fams, kv, eng
 
 
 class ServingMetrics:
@@ -59,6 +132,15 @@ class ServingMetrics:
         # calibration.harvest_serve_dispatch keys its dispatch entries
         # on it ("" = the pre-fleet single-engine default)
         self.model_tag = str(model)
+        # lifetime counters live in the process metrics registry
+        # (obs.registry): snapshot()/serve_stats READ them back — one
+        # set of numbers behind both the event stream and /metrics
+        self._ctr, self._fams, self._label_kv, self.eng_id = \
+            _lifetime_counters(self.model_tag)
+        self._ctr["queue_depth"].set_fn(
+            lambda: (self.queue_depth_fn() if self.queue_depth_fn
+                     else 0))
+        self._released = False
         self._lock = threading.Lock()
         # every rolling-window structure and counter below is
         # guarded_by self._lock (RL009): records arrive from producer
@@ -85,15 +167,49 @@ class ServingMetrics:
         # the dispatcher's heartbeat: last dispatch completion time,
         # the stall gauge last_dispatch_age_s reads
         self._last_dispatch_t: Optional[float] = None  # guarded_by: self._lock
-        self.total_dispatches = 0  # guarded_by: self._lock
-        self.total_requests = 0    # guarded_by: self._lock
-        self.total_rows = 0        # guarded_by: self._lock
-        self.total_errors = 0      # guarded_by: self._lock
-        self.total_rejected = 0    # guarded_by: self._lock
-        self.total_shed = 0        # guarded_by: self._lock
-        self.total_expired = 0     # guarded_by: self._lock
-        self.total_cancelled = 0   # guarded_by: self._lock
-        self.blocked_ms_total = 0.0  # guarded_by: self._lock
+
+    # lifetime counters: views over the registry children (each child
+    # synchronizes itself) — the serve_stats/stats() population and the
+    # Prometheus exposition are the SAME numbers by construction
+    @property
+    def total_submitted(self) -> int:
+        return int(self._ctr["submitted"].value)
+
+    @property
+    def total_dispatches(self) -> int:
+        return int(self._ctr["dispatches"].value)
+
+    @property
+    def total_requests(self) -> int:
+        return int(self._ctr["requests"].value)
+
+    @property
+    def total_rows(self) -> int:
+        return int(self._ctr["rows"].value)
+
+    @property
+    def total_errors(self) -> int:
+        return int(self._ctr["errors"].value)
+
+    @property
+    def total_rejected(self) -> int:
+        return int(self._ctr["rejected"].value)
+
+    @property
+    def total_shed(self) -> int:
+        return int(self._ctr["shed"].value)
+
+    @property
+    def total_expired(self) -> int:
+        return int(self._ctr["expired"].value)
+
+    @property
+    def total_cancelled(self) -> int:
+        return int(self._ctr["cancelled"].value)
+
+    @property
+    def blocked_ms_total(self) -> float:
+        return self._ctr["blocked_s"].value * 1e3
 
     # hard cap on windowed admission/drop EVENTS (not requests — each
     # entry may carry n>1): bounds memory even when the window itself
@@ -118,27 +234,29 @@ class ServingMetrics:
     def record_dispatch(self, rows: int, bucket: int, n_reqs: int,
                         queue_depth: int, dispatch_s: float) -> None:
         now = self.clock()
+        self._ctr["dispatches"].inc()
+        self._ctr["rows"].inc(rows)
         with self._lock:
             self._dispatches.append((now, rows, bucket, n_reqs, dispatch_s))
             self._queue_depth = queue_depth
             self._last_dispatch_t = now
-            self.total_dispatches += 1
-            self.total_rows += rows
             self._trim(now)
 
     def record_request(self, latency_s: float,
                        deadlined: bool = False) -> None:
         now = self.clock()
+        self._ctr["requests"].inc()
+        self._ctr["latency"].observe(latency_s)
         with self._lock:
             self._latencies.append((now, latency_s))
             if deadlined:
                 self._deadline_lats.append((now, latency_s))
-            self.total_requests += 1
 
     def record_submitted(self, n: int = 1) -> None:
         """Offered-load denominator for the windowed drop rate: one per
         LOGICAL request entering submit(), admitted or not."""
         now = self.clock()
+        self._ctr["submitted"].inc(n)
         with self._lock:
             self._submit_ts.append((now, int(n)))
             self._submit_n += int(n)
@@ -148,8 +266,8 @@ class ServingMetrics:
         """Requests refused at admission (OverloadError from submit —
         they never queued, so no future carries the failure)."""
         now = self.clock()
+        self._ctr["rejected"].inc(n)
         with self._lock:
-            self.total_rejected += int(n)
             self._drop_ts.append((now, int(n)))
             self._drop_n += int(n)
             self._trim(now)
@@ -158,34 +276,80 @@ class ServingMetrics:
         """Producer time spent blocked for admission (`block` policy) —
         invisible in latency percentiles (the request had not been
         submitted yet) but very visible to the caller."""
-        with self._lock:
-            self.blocked_ms_total += float(seconds) * 1e3
+        self._ctr["blocked_s"].inc(float(seconds))
+
+    def record_cancelled(self, n: int = 1) -> None:
+        """A client cancelled a QUEUED request before the engine ever
+        claimed it: no future resolution carries an exception, but the
+        request WAS submitted — without this the
+        ``submitted == requests + ... + cancelled`` reconciliation
+        (and its terminal-span mirror) would leak one per cancel."""
+        self._ctr["cancelled"].inc(n)
 
     def record_failure(self, exc: BaseException) -> None:
-        """ONE classification point for every exception that resolves a
-        LOGICAL request's future: expiry and shedding are load
-        management (their own counters, and sheds feed the windowed
-        drop rate), anything else is a dispatch error.  Split chunks
-        count their request once — the caller only invokes this for the
-        completion that actually resolved the future, so the population
-        matches every other per-request metric."""
+        """Count the exception that resolved a LOGICAL request's
+        future.  The classification IS ``obs.trace.phase_of`` — the
+        same chain that names the terminal span's phase — so the
+        counters and the trace cannot disagree about an outcome.
+        Expiry/shedding are load management (their own counters; sheds
+        and rejects feed the windowed drop rate), client cancels are
+        not dispatch failures, anything unrecognized is an error.
+        Split chunks count their request once — the caller only
+        invokes this for the completion that actually resolved the
+        future, so the population matches every other per-request
+        metric."""
         now = self.clock()
-        with self._lock:
-            if isinstance(exc, DeadlineExceeded):
-                self.total_expired += 1
-            elif isinstance(exc, SheddedError):
-                self.total_shed += 1
+        phase = phase_of(exc)
+        if phase in ("shed", "rejected"):
+            # `rejected` here is the anomalous resolved-future case
+            # (admission rejects raise synchronously and never build a
+            # future) — counted as rejected so both surfaces agree
+            self._ctr[phase].inc()
+            with self._lock:
                 self._drop_ts.append((now, 1))
                 self._drop_n += 1
                 self._trim(now)
-            elif isinstance(exc, GenerationCancelled):
-                # a client (or the serve_cancel_at_token fault) ended
-                # the stream — NOT a dispatch failure; counting it as
-                # one would make a healthy engine whose clients cancel
-                # look like it is throwing errors
-                self.total_cancelled += 1
-            else:
-                self.total_errors += 1
+        elif phase in ("expired", "cancelled"):
+            self._ctr[phase].inc()
+        else:
+            self._ctr["errors"].inc()
+
+    def release(self) -> None:
+        """Retire this metrics object's LIVE hooks from the process
+        registry: freeze the queue-depth gauge at its final value and
+        drop the provider closure.  Counters stay readable forever
+        (scrape continuity across engine generations), but a stopped
+        engine — and through ``queue_depth_fn`` its batcher, and
+        through the batcher the model — must not be retained by the
+        process-global registry for the rest of the process lifetime.
+        Called by the engines' stop()/drain() finalization."""
+        if self._released:
+            return  # idempotent: a second stop() must not re-zero
+        self._released = True
+        fn = self.queue_depth_fn
+        last = 0
+        if fn is not None:
+            try:
+                last = int(fn())
+            except Exception:  # noqa: BLE001 — provider already dead
+                last = 0
+        child = self._ctr["queue_depth"]
+        child.set(last)
+        child.set_fn(None)
+        self.queue_depth_fn = None
+
+    def unregister(self) -> None:
+        """Remove this object's label series from the registry entirely
+        (implies :meth:`release`).  Direct child references — including
+        this object's own properties — keep working, but the series
+        stop being rendered/summed: the fleet's bounded-retirement
+        scheme folds an old engine generation's final counts into a
+        static carry and then reclaims its series, so a week of hot
+        swaps cannot grow registry memory or the /metrics payload
+        without bound."""
+        self.release()
+        for key, fam in self._fams.items():
+            fam.remove(**self._label_kv)
 
     def drop_stats(self) -> Tuple[float, int]:
         """Windowed (drop_rate, submitted) — drops are shed + rejected;
@@ -234,7 +398,7 @@ class ServingMetrics:
                       self.total_rows, self.total_errors,
                       self.total_rejected, self.total_shed,
                       self.total_expired, self.blocked_ms_total,
-                      self.total_cancelled)
+                      self.total_cancelled, self.total_submitted)
         span = self.window_s
         if disp:
             span = min(self.window_s, max(1e-6, now - disp[0][0]))
@@ -292,6 +456,11 @@ class ServingMetrics:
             "shed": totals[5],
             "expired": totals[6],
             "cancelled": totals[8],
+            # offered-load lifetime total: submitted == requests +
+            # rejected + shed + expired + errors + cancelled, the exact
+            # reconciliation serve-bench (and the trace terminal-span
+            # counts) pin
+            "submitted": totals[9],
             "admission_blocked_ms": round(totals[7], 3),
         }
 

@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""CI gate for the committed observability artifacts (ISSUE 13): a
+trace-format or exposition-format change can never rot silently.
+
+Validates, in one device-free process (run by static_checks.sh):
+
+* every ``artifacts/trace_*.chrome.json`` against the Chrome-trace
+  schema (``obs.trace.validate_chrome_trace``);
+* every raw ``artifacts/trace_*.json`` against the ``ff-trace-v1``
+  schema, re-exports it with the CURRENT ``to_chrome`` and checks the
+  committed chrome artifact still matches event-for-event (the
+  exporter and the committed export cannot drift apart);
+* every ``artifacts/serve_trace_*.json`` bench payload: its ``trace``
+  section must say ``reconciled: true`` and its per-phase terminal
+  counts must equal a fresh recount over the committed raw file;
+* every ``artifacts/metrics_prom_*.txt`` against the Prometheus text
+  exposition rules (``obs.registry.validate_prometheus_text``),
+  including at least one ff_serve_* family being present.
+
+Exit 0 clean, 1 on any problem.  Device-free: only the obs validators
+run — nothing is traced, no mesh is built (same CPU-only contract as
+check_strategy_artifacts.py).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from flexflow_tpu.obs.registry import validate_prometheus_text  # noqa: E402
+from flexflow_tpu.obs.trace import (to_chrome,  # noqa: E402
+                                    validate_chrome_trace,
+                                    validate_raw_trace)
+
+problems = []
+
+
+def _load(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        problems.append(f"{path}: cannot load: {e}")
+        return None
+
+
+def main() -> int:
+    art = os.path.join(REPO, "artifacts")
+    raws = {}
+    raw_paths = [p for p in glob.glob(os.path.join(art, "trace_*.json"))
+                 if not p.endswith(".chrome.json")]
+    for path in sorted(raw_paths):
+        obj = _load(path)
+        if obj is None:
+            continue
+        probs = validate_raw_trace(obj)
+        for p in probs:
+            problems.append(f"{path}: {p}")
+        if not probs:
+            raws[os.path.basename(path)[:-len(".json")]] = (path, obj)
+            print(f"ok: {os.path.relpath(path, REPO)} "
+                  f"({len(obj['spans'])} spans)")
+
+    for path in sorted(glob.glob(os.path.join(art,
+                                              "trace_*.chrome.json"))):
+        obj = _load(path)
+        if obj is None:
+            continue
+        probs = validate_chrome_trace(obj)
+        for p in probs:
+            problems.append(f"{path}: {p}")
+        if probs:
+            continue
+        # the committed export must match what the CURRENT exporter
+        # produces from the committed raw trace
+        stem = os.path.basename(path)[:-len(".chrome.json")]
+        if stem in raws:
+            # FULL equality, not an event count: the exporter is pure
+            # over the committed raw file, so any field/format drift
+            # (scaled timestamps, renamed args, dropped trace ids)
+            # must fail here, count-preserving or not
+            fresh = to_chrome(raws[stem][1])
+            if fresh != obj:
+                problems.append(
+                    f"{path}: differs from what the current exporter "
+                    f"produces from {raws[stem][0]} — re-export the "
+                    f"artifact (flexflow-tpu trace export)")
+        print(f"ok: {os.path.relpath(path, REPO)} "
+              f"({len(obj['traceEvents'])} events)")
+
+    for path in sorted(glob.glob(os.path.join(art,
+                                              "serve_trace_*.json"))):
+        obj = _load(path)
+        if obj is None:
+            continue
+        tr = obj.get("trace") or {}
+        if tr.get("reconciled") is not True:
+            problems.append(f"{path}: trace.reconciled is not true")
+            continue
+        raw_name = os.path.basename(str(tr.get("file", "")))
+        raw_path = os.path.join(art, raw_name)
+        if os.path.exists(raw_path):
+            raw = _load(raw_path)
+            if raw is not None:
+                fresh = {}
+                for s in raw.get("spans", []):
+                    if s.get("name") == "request":
+                        ph = (s.get("args") or {}).get("phase", "?")
+                        fresh[ph] = fresh.get(ph, 0) + 1
+                if fresh != tr.get("terminal_phases"):
+                    problems.append(
+                        f"{path}: terminal_phases {tr.get('terminal_phases')} "
+                        f"!= recount {fresh} over {raw_name}")
+        print(f"ok: {os.path.relpath(path, REPO)} (reconciled, "
+              f"{tr.get('spans')} spans)")
+
+    for path in sorted(glob.glob(os.path.join(art,
+                                              "metrics_prom_*.txt"))):
+        try:
+            with open(path) as f:
+                text = f.read()
+        except OSError as e:
+            problems.append(f"{path}: cannot load: {e}")
+            continue
+        for p in validate_prometheus_text(text):
+            problems.append(f"{path}: {p}")
+        if "ff_serve_" not in text:
+            problems.append(f"{path}: no ff_serve_* family in the "
+                            f"exposition")
+        else:
+            print(f"ok: {os.path.relpath(path, REPO)} "
+                  f"({len(text.splitlines())} lines)")
+
+    if problems:
+        for p in problems:
+            print(f"PROBLEM: {p}", file=sys.stderr)
+        return 1
+    print("trace/metrics artifacts: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -38,6 +38,11 @@ declare -a cases=(
   # untouched, and a held publish must land exactly at the pinned
   # dispatch boundary (docs/serving.md "Model fleets")
   "$FAST_TIMEOUT tests/test_fleet.py::TestFleetFaults"
+  # flight recorder under faults (docs/observability.md): an injected
+  # serve_fail_dispatch must leave a dump in FF_FLIGHT_DIR naming the
+  # failed dispatch and retaining its request spans; a health edge
+  # into `degraded` dumps too, and the flight CLI reads both
+  "$FAST_TIMEOUT tests/test_obs.py::TestFlightFaults"
 )
 if [ "${1:-}" != "--fast-only" ]; then
   cases+=(

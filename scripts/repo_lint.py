@@ -87,6 +87,15 @@ by tier-1 ``tests/test_static_checks.py``).  Rules:
   itself), as is ``serving/bench.py`` — the benchmark harness
   DRIVES real wall-clock runs; it measures the engine, it is not the
   engine.
+* **RL011 — every emitted event name is declared in the registry**
+  (ISSUE 13): a ``Category.event("name", ...)`` call site in
+  ``flexflow_tpu/`` must pass a string literal declared in
+  ``flexflow_tpu/obs/events.py`` — a typo'd name produces a valid
+  JSON line every harvester (``calibrate``'s capture_events hook,
+  serve-bench reconciliation, the flight recorder) silently ignores.
+  A non-literal name needs an ``RL011-ok:`` comment naming the
+  literals it can resolve to (each declared).  ``fflogger.py`` (the
+  definition site) and tests/scripts are out of scope.
 
 Exit 0 when clean, 1 with ``file:line: RLxxx message`` findings on
 stdout.  No third-party deps — must run on a bare CPython.
@@ -124,6 +133,40 @@ def _dotted(node: ast.AST) -> Optional[str]:
 
 def _rel(path: str) -> str:
     return os.path.relpath(path, REPO).replace(os.sep, "/")
+
+
+# RL011: the declared event-name registry, parsed by AST from the REAL
+# repo's flexflow_tpu/obs/events.py (not imported — the lint must run
+# on a bare CPython, and not relative to a patched REPO root so the
+# synthetic-file tests still validate against the true registry)
+_EVENT_REGISTRY: Optional[frozenset] = None
+
+
+def _declared_events() -> frozenset:
+    global _EVENT_REGISTRY
+    if _EVENT_REGISTRY is not None:
+        return _EVENT_REGISTRY
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..",
+        "flexflow_tpu", "obs", "events.py")
+    names: set = set()
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == "EVENTS"
+                            for t in node.targets)
+                    and isinstance(node.value, ast.Dict)):
+                for k in node.value.keys:
+                    if isinstance(k, ast.Constant) \
+                            and isinstance(k.value, str):
+                        names.add(k.value)
+    except (OSError, SyntaxError):
+        pass  # registry unreadable: RL011 reports nothing rather than
+        #       flagging every event site with a bogus finding
+    _EVENT_REGISTRY = frozenset(names)
+    return _EVENT_REGISTRY
 
 
 # host-sync call sites banned inside fit/evaluate/predict batch loops
@@ -315,7 +358,45 @@ class _Visitor(ast.NodeVisitor):
             self._check_step_sync(node, name)
             self._check_raw_mesh(node, name)
             self._check_clock(node, name)
+        self._check_event_name(node)
         self.generic_visit(node)
+
+    def _check_event_name(self, node: ast.Call) -> None:
+        """RL011: ``<logger>.event(<name>, ...)`` call sites in the
+        library must pass a string literal declared in
+        flexflow_tpu/obs/events.py (fflogger.py — the definition site —
+        is exempt, as are tests/scripts)."""
+        if (not self.in_library
+                or self.relpath == "flexflow_tpu/fflogger.py"
+                or not isinstance(node.func, ast.Attribute)
+                or node.func.attr != "event"
+                or not node.args):
+            return
+        registry = _declared_events()
+        if not registry:
+            return
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            if arg.value not in registry:
+                self._add(node, "RL011",
+                          f"event name {arg.value!r} is not declared in "
+                          f"flexflow_tpu/obs/events.py — undeclared "
+                          f"names vanish silently from every harvester; "
+                          f"declare it (one line + contract) or fix the "
+                          f"typo")
+            return
+        # non-literal name: allowed only with an RL011-ok waiver that
+        # names the declared literals it resolves to
+        for ln in range(node.lineno,
+                        min(len(self.lines), node.lineno + 3) + 1):
+            if "RL011-ok" in (self.lines[ln - 1]
+                              if 0 < ln <= len(self.lines) else ""):
+                return
+        self._add(node, "RL011",
+                  "non-literal event name — every Category.event call "
+                  "site must pass a declared literal (obs/events.py), "
+                  "or carry an 'RL011-ok: <literals>' comment when the "
+                  "name is a validated parameter")
 
     def visit_Constant(self, node: ast.Constant) -> None:
         v = node.value

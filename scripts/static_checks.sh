@@ -55,6 +55,12 @@ python scripts/check_strategy_artifacts.py || rc=1
 echo "== fleet artifacts (registry + bench schema) =="
 python scripts/check_fleet_artifacts.py || rc=1
 
+# committed trace exports + Prometheus exposition snapshots must keep
+# validating against the CURRENT schemas/exporter — an observability
+# format change can never rot silently (docs/observability.md)
+echo "== trace/metrics artifacts (chrome trace + prom exposition) =="
+python scripts/check_trace_artifacts.py || rc=1
+
 if [ "$rc" -eq 0 ]; then
     echo "static checks: OK"
 else

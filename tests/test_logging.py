@@ -1,12 +1,17 @@
 """Structured logging tests (VERDICT Missing#5: reference logger categories
-model.cc:22, mapper.cc:18, flexflow_logger.py)."""
+model.cc:22, mapper.cc:18, flexflow_logger.py) + the ISSUE 13
+observability satellites: thread-safe capture registration, monotonic-ns
+event timestamps, capture/silenced interaction across threads, and
+model-tagged harvest attribution under concurrent emitters."""
 
 import json
+import threading
 
 import numpy as np
 
 import flexflow_tpu as ff
-from flexflow_tpu.fflogger import Category, get_logger
+from flexflow_tpu.fflogger import (Category, capture_events, get_logger,
+                                   silenced)
 
 
 def test_category_levels(monkeypatch, capsys):
@@ -36,6 +41,125 @@ def test_event_json_line(capsys):
     rec = json.loads(line)
     assert rec["cat"] == "ff" and rec["event"] == "epoch"
     assert rec["epoch"] == 3 and rec["loss"] == 1.5
+
+
+def test_event_timestamps_monotonic_ns(capsys):
+    """Satellite pin (ISSUE 13): every event carries BOTH the human
+    wall clock (`t`, 1ms granularity) and a monotonic integer-ns field
+    (`t_ns`) — two events emitted back-to-back used to collapse onto
+    one wall-clock stamp, and a clock step could reorder them."""
+    log = get_logger("tns")
+    for i in range(50):
+        log.event("epoch", i=i)
+    recs = [json.loads(line) for line in
+            capsys.readouterr().out.splitlines() if line.startswith("{")]
+    assert len(recs) == 50
+    ns = [r["t_ns"] for r in recs]
+    assert all(isinstance(v, int) for v in ns)
+    # ordering pin: the monotonic field NEVER goes backwards, and it
+    # resolves emissions the 1ms wall stamp collapses
+    assert ns == sorted(ns)
+    assert len(set(ns)) > len({r["t"] for r in recs}) or len(ns) == len(
+        set(ns))
+    assert all("t" in r for r in recs)
+
+
+def test_capture_registration_threadsafe_under_emitters():
+    """Satellite pin (ISSUE 13): capture contexts entering/exiting
+    while other threads emit concurrently — the old lockless list
+    mutation raced Category.event's iteration (a capture exiting
+    mid-iteration could skip/duplicate sinks or blow up)."""
+    log = get_logger("race")
+    errors = []
+    stop = threading.Event()
+
+    def emitter():
+        try:
+            while not stop.is_set():
+                log.event("epoch", x=1)
+        except BaseException as e:  # noqa: BLE001 — the failure pin
+            errors.append(e)
+
+    def churner():
+        try:
+            for _ in range(300):
+                with capture_events("race") as sink:
+                    log.event("epoch", inner=True)
+                    assert any(r.get("inner") for r in sink)
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    with silenced("race"):
+        threads = ([threading.Thread(target=emitter) for _ in range(3)]
+                   + [threading.Thread(target=churner) for _ in range(2)])
+        for t in threads:
+            t.start()
+        for t in threads[3:]:
+            t.join(60)
+        stop.set()
+        for t in threads[:3]:
+            t.join(60)
+    assert errors == []
+
+
+def test_capture_nesting_mute_silenced_across_threads(capsys):
+    """capture_events nesting x mute x silenced(), with emissions from
+    a second thread: both sinks see every matching event, the muted
+    inner capture keeps stdout clean, and silenced() cannot hide
+    events from captures (they hook before the level gate)."""
+    log = get_logger("nested")
+    with silenced("nested"):
+        with capture_events("nested", mute=False) as outer:
+            with capture_events("nested", mute=True) as inner:
+                worker = threading.Thread(
+                    target=lambda: log.event("epoch", src="thread"))
+                worker.start()
+                worker.join(30)
+                log.event("epoch", src="main")
+            # inner exited: outer alone (mute=False), but silenced()
+            # still keeps stdout clean
+            log.event("epoch", src="after")
+    assert [r["src"] for r in inner] == ["thread", "main"]
+    assert [r["src"] for r in outer] == ["thread", "main", "after"]
+    assert capsys.readouterr().out == ""
+    # identity-based removal pinned: the nested exit above popped the
+    # INNER entry even while both held equal records
+    with capture_events("nested") as again:
+        log.event("epoch", src="clean")
+    assert len(again) == 1
+
+
+def test_harvest_attributes_model_tagged_events_concurrently():
+    """Two engines' serve_stats events emitted concurrently harvest
+    into DISTINCT calibration keys — the model tag, not arrival order,
+    owns the attribution (ISSUE 13 satellite)."""
+    from flexflow_tpu.search.calibration import (CalibrationTable,
+                                                 harvest_serve_dispatch)
+    from flexflow_tpu.serving.metrics import ServingMetrics
+
+    ma = ServingMetrics(model="tenant_a")
+    mb = ServingMetrics(model="tenant_b")
+    # distinct per-bucket dispatch medians per tenant
+    for _ in range(5):
+        ma.record_dispatch(4, 4, 1, 0, 0.010)
+        mb.record_dispatch(8, 8, 1, 0, 0.030)
+
+    with silenced("serve"), capture_events("serve") as sink:
+        ta = threading.Thread(target=lambda: [ma.emit() for _ in range(20)])
+        tb = threading.Thread(target=lambda: [mb.emit() for _ in range(20)])
+        ta.start(), tb.start()
+        ta.join(60), tb.join(60)
+    stats = [r for r in sink if r["event"] == "serve_stats"]
+    assert len(stats) == 40
+    table = CalibrationTable()
+    for rec in stats:
+        harvest_serve_dispatch(table, None, rec)
+    assert table.dispatch["serve|tenant_a|bucket4"]["measured_ms"] == \
+        (10.0)
+    assert table.dispatch["serve|tenant_b|bucket8"]["measured_ms"] == \
+        (30.0)
+    assert "serve|tenant_a|bucket8" not in table.dispatch
+    assert "serve|tenant_b|bucket4" not in table.dispatch
 
 
 def test_fit_emits_epoch_event(capsys):

@@ -390,6 +390,31 @@ def test_static_checks_script_passes_on_repo():
     ("flexflow_tpu/search/zz_ok_small.py",
      "INF_SENTINEL = 1e29\nEPS = 1e-6\nn = 4096\n",
      None),
+    # RL011: an event name not declared in obs/events.py vanishes
+    # silently from every harvester (ISSUE 13)
+    ("flexflow_tpu/zz_bad_event.py",
+     "from .fflogger import get_logger\n\ndef f():\n"
+     "    get_logger('serve').event('serve_statz', qps=1)\n",
+     "RL011"),
+    ("flexflow_tpu/zz_ok_event.py",
+     "from .fflogger import get_logger\n\ndef f():\n"
+     "    get_logger('serve').event('serve_stats', qps=1)\n",
+     None),
+    # a non-literal name needs the RL011-ok waiver naming its literals
+    ("flexflow_tpu/zz_bad_event_var.py",
+     "from .fflogger import get_logger\n\ndef f(name):\n"
+     "    get_logger('serve').event(name, qps=1)\n",
+     "RL011"),
+    ("flexflow_tpu/zz_ok_event_var.py",
+     "from .fflogger import get_logger\n\ndef f(name):\n"
+     "    get_logger('serve').event(  # RL011-ok: serve_stats\n"
+     "        name, qps=1)\n",
+     None),
+    # tests/scripts are out of RL011 scope (harnesses emit ad-hoc)
+    ("tests/zz_ok_event_test.py",
+     "from flexflow_tpu.fflogger import get_logger\n\ndef f():\n"
+     "    get_logger('serve').event('totally_adhoc', x=1)\n",
+     None),
 ])
 def test_repo_lint_rules(tmp_path, rel, src, code):
     """repo_lint unit check on synthetic files, laid out under tmp_path
