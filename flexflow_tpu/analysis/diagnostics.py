@@ -59,6 +59,10 @@ CODES: Dict[str, tuple] = {
     "FF130": (Severity.ERROR,
               "fleet co-residency: summed per-device memory exceeds HBM"),
     "FF131": (Severity.INFO, "fleet per-model residency breakdown"),
+    # precision-axis passes (ISSUE 14)
+    "FF140": (Severity.ERROR,
+              "precision override on an fp32-pinned op (loss/norm stats)"),
+    "FF141": (Severity.INFO, "per-op precision policy summary"),
 }
 
 

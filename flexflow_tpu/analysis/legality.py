@@ -29,12 +29,56 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
-from ..config import ParallelConfig
-from ..op import Op
+from ..config import PRECISIONS, ParallelConfig
+from ..op import Op, OpType
 from ..parallel.mesh import (degree_expressible, dim_axis_names,
                              expressible_degrees)
 
 MeshShape = Dict[str, int]
+
+# Ops whose numerics are pinned to fp32 regardless of the precision
+# axis (ISSUE 14): loss heads and normalization statistics.  Their
+# forward already promotes to f32 internally (ops/norm.py,
+# tensor_ops.Softmax, loss_ops) — a bf16 override would either be a
+# no-op the simulator mis-costs or a numerics change the training
+# contract forbids.  THE one pinned set, shared by the search's
+# precision proposals (mcmc.search) and the FF140 verifier pass, so
+# the walk can never propose a precision the verifier rejects.
+F32_PINNED_OPS = frozenset({
+    OpType.MSELOSS, OpType.SOFTMAX, OpType.BATCHNORM,
+    OpType.LAYERNORM, OpType.RMSNORM,
+})
+
+
+def allowed_precisions(op: Op) -> Tuple[str, ...]:
+    """The precision tokens a strategy may legally assign to ``op``:
+    every op accepts "" (follow FFConfig.compute_dtype) and "f32";
+    "bf16" is excluded for the :data:`F32_PINNED_OPS` classes."""
+    if op.op_type in F32_PINNED_OPS:
+        return ("", "f32")
+    return PRECISIONS
+
+
+def precision_diagnostics(op: Op, pc: Optional[ParallelConfig]) -> List:
+    """FF140 — a strategy pins a precision the op's numerics contract
+    forbids (bf16 on a loss/norm-statistics op).  Returns [] exactly
+    when the op's precision token is in :func:`allowed_precisions`
+    (unknown tokens are rejected at ParallelConfig construction and at
+    the proto layer, so only the pinned-class check remains here)."""
+    from .diagnostics import make
+
+    if pc is None:
+        return []
+    prec = getattr(pc, "precision", "")
+    if not prec or prec in allowed_precisions(op):
+        return []
+    return [make(
+        "FF140", op.name,
+        f"precision {prec!r} on a {op.op_type.value} op — loss and "
+        f"norm-statistics ops are pinned fp32 (their forward promotes "
+        f"to f32 internally; a bf16 pin would change training numerics "
+        f"or be mis-costed as a speedup)",
+        hint="drop the precision override or use 'f32'")]
 
 
 def degree_executable(extent: int, degree: int, axis_size: int,

@@ -15,7 +15,7 @@ from ..config import ParallelConfig
 from ..op import Op
 from .diagnostics import Diagnostic, DiagnosticReport, make
 from .graph_passes import graph_diagnostics
-from .legality import config_diagnostics
+from .legality import config_diagnostics, precision_diagnostics
 from .strategy_passes import (host_placement_diagnostics, infer_mesh_shape,
                               memory_diagnostics, resharding_diagnostics)
 
@@ -80,12 +80,32 @@ def verify(layers: List[Op],
                 f"(strategies attach by exact op name)",
                 hint="check the op name spelling in the .pb/dict"))
 
+    n_bf16 = n_f32 = 0
     for op in layers:
         pc = strategies.get(op.name)
         if pc is None or not op.outputs:
             continue
         report.extend(config_diagnostics(op, pc, mesh_shape, num_devices))
         report.extend(host_placement_diagnostics(op, pc))
+        # FF140 — precision-legality (ISSUE 14): bf16 pins on
+        # loss/norm-statistics ops are rejected with the same predicate
+        # the search's precision proposals draw from
+        report.extend(precision_diagnostics(op, pc))
+        prec = getattr(pc, "precision", "")
+        if prec == "bf16":
+            n_bf16 += 1
+        elif prec == "f32":
+            n_f32 += 1
+    if n_bf16 or n_f32:
+        # FF141 — one INFO row summarizing the mixed-precision policy,
+        # so `lint --json` (and explain) surface WHAT the strategy pins
+        # without a per-op flood; absent entirely for default-precision
+        # strategies (every shipped .pb reads unchanged)
+        report.add(make(
+            "FF141", "",
+            f"per-op precision overrides: {n_bf16} op(s) bf16, "
+            f"{n_f32} op(s) f32 (unpinned ops follow "
+            f"FFConfig.compute_dtype)"))
 
     # FF120 — the static sharding-propagation pass (ISSUE 9): run the
     # TRACER's placement functions against a device-free AbstractMesh

@@ -40,6 +40,12 @@ def main(argv=None) -> None:
         from .serving.bench import main as serve_bench_main
         serve_bench_main(argv[1:])
         return
+    if argv and argv[0] == "precision-bench":
+        # precision axis + int8 serving evidence artifact
+        # (docs/performance.md "Precision policy")
+        from .precision_bench import main as precision_bench_main
+        precision_bench_main(argv[1:])
+        return
     if argv and argv[0] == "calibrate":
         # harvest measured op/dispatch timings into a CalibrationTable,
         # or --check existing artifacts (docs/strategy_search.md)
@@ -83,6 +89,7 @@ def main(argv=None) -> None:
               "       flexflow-tpu train-bench [flags]\n"
               "       flexflow-tpu serve-bench [--overload|--generate|"
               "--fleet] [flags]\n"
+              "       flexflow-tpu precision-bench [--out f.json]\n"
               "       flexflow-tpu calibrate [--out table.json | "
               "--check FILE...]\n"
               "       flexflow-tpu calibrate-bench --table table.json "

@@ -43,6 +43,36 @@ class MemoryType(enum.IntEnum):
     ZCM = 1  # zero-copy (host-pinned) -> host memory
 
 
+# The per-op precision axis of the SOAP space (ISSUE 14): a strategy may
+# pin one op's compute dtype independently of FFConfig.compute_dtype.
+# "" = follow the run's global compute dtype (the backward-compatible
+# default every shipped .pb reads as); "bf16"/"f32" force the op.  Wire
+# values in strategy.proto field 6: 0 = follow, 1 = bf16, 2 = f32.
+PRECISIONS = ("", "bf16", "f32")
+# precision token -> jnp dtype name (the "" default resolves to the
+# session dtype at the ONE trace-time resolution point, ops/common.py)
+PRECISION_DTYPES = {"bf16": "bfloat16", "f32": "float32"}
+# dtype names FFConfig.compute_dtype / param_dtype may take — validated
+# at construction so a typo fails with the field name, not deep inside
+# jnp.dtype at trace time
+VALID_COMPUTE_DTYPES = ("bfloat16", "float32", "float16")
+VALID_PARAM_DTYPES = ("float32", "bfloat16", "float64")
+
+
+def _validate_dtype_field(field: str, value: str, allowed) -> None:
+    if value not in allowed:
+        raise ValueError(
+            f"FFConfig.{field} must be one of {', '.join(allowed)}, got "
+            f"{value!r}")
+
+
+def dtype_short(dtype_name: str) -> str:
+    """The ONE dtype -> bench-tag spelling ("bfloat16" -> "bf16"), so
+    every bench's precision_policy stamp shares a vocabulary."""
+    return {"bfloat16": "bf16", "float32": "f32",
+            "float16": "f16"}.get(dtype_name, dtype_name)
+
+
 @dataclasses.dataclass(frozen=True)
 class ParallelConfig:
     """The SOAP strategy atom (reference ``config.h:42-51``).
@@ -62,6 +92,17 @@ class ParallelConfig:
     dims: Tuple[int, ...] = (1,)
     device_ids: Tuple[int, ...] = (0,)
     memory_types: Tuple[MemoryType, ...] = ()
+    # per-op precision (the SOAP precision axis, ISSUE 14): "" follows
+    # FFConfig.compute_dtype — the default every pre-existing strategy
+    # (and every shipped .pb, which has no field 6) resolves to, so the
+    # default policy is bit-identical to a build without the axis.
+    precision: str = ""
+
+    def __post_init__(self):
+        if self.precision not in PRECISIONS:
+            raise ValueError(
+                f"ParallelConfig.precision must be one of "
+                f"{PRECISIONS}, got {self.precision!r}")
 
     @property
     def num_parts(self) -> int:
@@ -79,6 +120,7 @@ class ParallelConfig:
             dims=tuple(int(d) for d in dims),
             device_ids=tuple(range(nparts)),
             memory_types=self.memory_types,
+            precision=self.precision,
         )
 
     @staticmethod
@@ -124,6 +166,14 @@ class FFConfig:
     search_alpha: float = 0.05  # --alpha: annealing temperature
     search_chains: int = 1      # --chains: independent MCMC chains
     search_overlap_backward_update: bool = False
+    # --search-precision: grow the SOAP space with the per-op precision
+    # axis (ISSUE 14) — MCMC proposals may flip one op between bf16 and
+    # f32 (loss/norm-statistics ops stay pinned f32 by the FF140
+    # legality pass) alongside partitioning mutations, and the cost
+    # model charges dtype-dependent compute rate + HBM traffic per op.
+    # OFF by default: the proposal distribution (and therefore every
+    # acceptance decision) is bit-identical to a build without the axis.
+    search_precision: bool = False
     # --reshard-budget: MCMC iterations for the IN-THE-LOOP re-search an
     # elastic reshard point runs (FFModel.reshard / reshard-on-resume,
     # docs/elastic.md "Resharding").  None = reuse search_budget; the
@@ -259,6 +309,17 @@ class FFConfig:
     # single-engine deployment whose event stream will be merged with
     # others' ("" = untagged single-engine default).
     serve_model_name: str = ""
+    # serve_quantize: weight quantization for the serving bucket
+    # executables (docs/serving.md "Int8 weight quantization").  "" =
+    # off (the default — serving params, executables and results are
+    # bit-identical to a build without quantization); "int8" =
+    # per-output-channel symmetric int8 weight-only quantization of the
+    # eligible matmul kernels (FFModel.quantize_weights), dequant fused
+    # into the matmul, with a max-abs-error quality bound checked at
+    # engine warmup.  Halves-to-quarters the weights' HBM residency and
+    # bandwidth; the fleet gate's resident_bytes accounting follows
+    # byte-for-byte.
+    serve_quantize: str = ""
     # serve_buckets: explicit comma-separated batch buckets ("2,4,16,64");
     # empty = powers of two 2,4,...,serve_max_batch (the default omits
     # bucket 1 to keep results packing-invariant — single-row programs
@@ -298,9 +359,39 @@ class FFConfig:
     # resolved at FFModel construction
     strategies: Dict[str, ParallelConfig] = dataclasses.field(default_factory=dict)
 
+    def __post_init__(self):
+        # fail at construction with the FIELD name — an unknown dtype
+        # string used to surface as an opaque jnp.dtype error deep
+        # inside the first trace (ISSUE 14 satellite)
+        _validate_dtype_field("compute_dtype", self.compute_dtype,
+                              VALID_COMPUTE_DTYPES)
+        _validate_dtype_field("param_dtype", self.param_dtype,
+                              VALID_PARAM_DTYPES)
+        if self.serve_quantize not in ("", "int8"):
+            raise ValueError(
+                f"FFConfig.serve_quantize must be '' or 'int8', got "
+                f"{self.serve_quantize!r}")
+
     @property
     def num_devices(self) -> int:
         return max(1, self.workers_per_node) * self.num_nodes
+
+    def precision_policy(self) -> str:
+        """Short human/bench tag of the run's precision policy, stamped
+        next to device_kind/calibration_digest in bench rows: the global
+        compute dtype ("bf16"/"f32"/...), "+mixed(B/F)" when per-op
+        strategy overrides are present (B ops bf16, F ops f32), and
+        "+int8w" under serving weight quantization."""
+        short = dtype_short(self.compute_dtype)
+        nb = sum(1 for pc in self.strategies.values()
+                 if pc is not None and pc.precision == "bf16")
+        nf = sum(1 for pc in self.strategies.values()
+                 if pc is not None and pc.precision == "f32")
+        if nb or nf:
+            short += f"+mixed({nb}bf16/{nf}f32)"
+        if self.serve_quantize:
+            short += f"+{self.serve_quantize}w"
+        return short
 
     @staticmethod
     def parse_args(argv: Optional[List[str]] = None) -> "FFConfig":
@@ -340,6 +431,8 @@ class FFConfig:
                 cfg.search_alpha = float(val())
             elif a == "--chains":
                 cfg.search_chains = max(1, int(val()))
+            elif a == "--search-precision":
+                cfg.search_precision = True
             elif a == "--reshard-budget":
                 cfg.reshard_search_budget = int(val())
             elif a == "--calibration":
@@ -378,6 +471,20 @@ class FFConfig:
                 cfg.serve_max_wait_ms = float(val())
             elif a == "--serve-buckets":
                 cfg.serve_buckets = val()
+            elif a == "--serve-quantize":
+                cfg.serve_quantize = val().lower()
+                if cfg.serve_quantize not in ("", "int8"):
+                    raise ValueError(
+                        f"--serve-quantize must be '' or 'int8', got "
+                        f"{cfg.serve_quantize!r}")
+            elif a == "--compute-dtype":
+                cfg.compute_dtype = val().lower()
+                _validate_dtype_field("compute_dtype", cfg.compute_dtype,
+                                      VALID_COMPUTE_DTYPES)
+            elif a == "--param-dtype":
+                cfg.param_dtype = val().lower()
+                _validate_dtype_field("param_dtype", cfg.param_dtype,
+                                      VALID_PARAM_DTYPES)
             elif a == "--serve-model-name":
                 cfg.serve_model_name = val()
             elif a == "--serve-max-queue-rows":

@@ -129,6 +129,13 @@ class FFModel:
         self._fwd_compiled: Dict[Any, Any] = {}
         self._exec_digest_cache: Optional[str] = None
         self._dummy_labels: Dict[int, np.ndarray] = {}
+        # serving weight quantization (ISSUE 14): "" = full-precision
+        # params; "int8" after quantize_weights() replaced the eligible
+        # matmul kernels in _params with int8 tensors + per-channel
+        # scales (one-way for this model instance — training verbs
+        # refuse to run on quantized weights)
+        self._quantized: str = ""
+        self._quant_report: Optional[Dict[str, Any]] = None
         # trace-time replicate-fallback sites drained so far (raw
         # (name, dim, degree, axis, axis_size, reason) tuples — the set
         # the static FF120 prediction must equal)
@@ -583,8 +590,20 @@ class FFModel:
         """Interpret a (sub)sequence of the layer list into ``values``
         (the reference's per-op IndexLauncher loop, model.cc:903-907,
         flattened into one XLA program) — shared by the plain and
-        remat-segmented executors."""
+        remat-segmented executors.
+
+        Per-op precision (ISSUE 14): each op's compute dtype is resolved
+        at the ONE point (``ops.common.resolve_op_dtype`` — strategy
+        ``precision`` override, else the session dtype) and installed as
+        ``ctx.compute_dtype`` for the duration of that op's forward, so
+        every ``cast_compute`` site follows the strategy without any op
+        knowing about the axis.  With no overrides the installed value
+        is the session dtype for every op — traced programs are
+        bit-identical to a build without the axis."""
+        from .ops.common import resolve_op_dtype
+        base_dtype = ctx.compute_dtype
         for op in ops:
+            ctx.compute_dtype = resolve_op_dtype(op, base_dtype)
             in_vals = [values[t.uid] for t in op.inputs]
             out_vals = op.forward(params, in_vals, ctx)
             for t, v in zip(op.outputs, out_vals):
@@ -593,6 +612,7 @@ class FFModel:
                     v = jax.lax.with_sharding_constraint(
                         v, self.mesh.sharding(spec))
                 values[t.uid] = v
+        ctx.compute_dtype = base_dtype
 
     def _execute(self, params: Dict[str, jax.Array],
                  inputs: Dict[int, jax.Array], ctx: OpContext,
@@ -1126,6 +1146,7 @@ class FFModel:
         ``<name>_step<N>.npz`` siblings after a successful publish so
         long elastic runs do not fill the disk; stale ``*.tmp.npz``
         orphans from killed writers are swept on every save."""
+        self._check_not_quantized("save_checkpoint")
         flat: Dict[str, np.ndarray] = {}
         for k, v in self._params.items():
             flat[f"param:{k}"] = self._gather_host(v)
@@ -1383,6 +1404,7 @@ class FFModel:
         after; a multi-GB recovery should not pay a full gather+put of
         state it is about to discard.  Returns a small report dict
         (old/new mesh, device counts, whether re-search ran)."""
+        self._check_not_quantized("reshard")
         assert self._compiled, "call compile() + init_layers() first"
         if (new_mesh is None) == (num_devices is None):
             raise ValueError("pass exactly one of new_mesh / num_devices")
@@ -1833,6 +1855,7 @@ class FFModel:
 
     def train_batch(self, *arrays) -> float:
         """One fused train step; returns loss."""
+        self._check_not_quantized("train_batch")
         if arrays:
             self._check_accum_divisible(len(arrays[0]), "batch of")
         batch = tuple(self._shard_batch(arrays))
@@ -1923,6 +1946,7 @@ class FFModel:
         way.  Per-step losses of the last epoch are kept on
         ``self.last_epoch_losses`` (host, fetched with the epoch's
         metric sums)."""
+        self._check_not_quantized("fit")
         cfg = self.config
         epochs = epochs or cfg.epochs
         bs = batch_size or cfg.batch_size
@@ -2129,6 +2153,7 @@ class FFModel:
         per-batch ``float()`` fetch would fence the async dispatch
         pipeline every batch, the exact anti-pattern fit() avoids
         (repo_lint RL004 locks this in)."""
+        self._check_not_quantized("evaluate")
         bs = batch_size or self.config.batch_size
         xs = x if isinstance(x, (list, tuple)) else [x]
         n = xs[0].shape[0]
@@ -2169,6 +2194,65 @@ class FFModel:
             self._dummy_labels[bs] = lab
         return lab
 
+    def quantize_weights(self, mode: str = "int8") -> Dict[str, Any]:
+        """Int8 weight-only quantization for serving (ISSUE 14,
+        docs/serving.md "Int8 weight quantization"): every eligible
+        matmul kernel (``serving.quantize.eligible_weights`` — the ONE
+        eligibility predicate the fleet gate shares) is replaced IN
+        ``self._params`` by a per-output-channel symmetric int8 tensor
+        plus its f32 ``<name>::scale`` vector, placed under the weight's
+        resolved sharding (scale replicated — it is (out,)-tiny).  The
+        dequantization fuses into the matmul at trace time
+        (``ops.common.dequant_matmul``), so the resident HBM footprint
+        and the weight-streaming bandwidth drop to ~1/4 of f32 — the
+        quantity the fleet gate's ``resident_bytes`` now predicts
+        byte-for-byte.
+
+        Returns the quality report: ``max_abs_err`` (measured, over all
+        quantized weights), ``error_bound`` (max per-channel scale / 2 —
+        the symmetric-rounding bound, which holds by construction), and
+        per-weight rows.  The serving engine checks the bound at warmup
+        and refuses to serve a violating table.
+
+        One-way for this model instance: training/eval verbs and
+        checkpointing refuse to run on quantized weights (build a fresh
+        model to train).  Idempotent — a second call with the same mode
+        returns the cached report."""
+        assert self._compiled and self._params, \
+            "compile() + init_layers() before quantize_weights()"
+        if self._quantized:
+            if self._quantized != mode:
+                raise ValueError(
+                    f"weights already quantized as {self._quantized!r}")
+            return self._quant_report
+        from .serving.quantize import quantize_params
+        new_params, report = quantize_params(self, mode)
+        self._params = new_params
+        self._quantized = mode
+        self._quant_report = report
+        # the params' avals changed: every AOT bucket executable lowered
+        # from the f32 params is stale, and the digest half of the cache
+        # key must change with them
+        self._fwd_compiled = {}
+        self._exec_digest_cache = None
+        from .fflogger import get_logger
+        get_logger("serve").event(
+            "quantize_weights", mode=mode,
+            weights=len(report["weights"]),
+            bytes_before=report["bytes_before"],
+            bytes_after=report["bytes_after"],
+            max_abs_err=report["max_abs_err"],
+            error_bound=report["error_bound"])
+        return report
+
+    def _check_not_quantized(self, verb: str) -> None:
+        if getattr(self, "_quantized", ""):
+            raise RuntimeError(
+                f"{verb}() is not available on a weight-quantized model "
+                f"(quantize_weights({self._quantized!r}) is one-way for "
+                f"this instance — serving-only); build and train a "
+                f"fresh model")
+
     def exec_digest(self) -> str:
         """sha256/16 over everything a lowered forward executable
         depends on: the op graph (names, types, output shapes/dtypes),
@@ -2195,10 +2279,15 @@ class FFModel:
             pc = op.parallel_config
             h.update(repr(None if pc is None else
                           (tuple(pc.dims), int(pc.device_type),
-                           tuple(pc.device_ids))).encode())
+                           tuple(pc.device_ids),
+                           getattr(pc, "precision", ""))).encode())
         if self.mesh is not None:
             h.update(repr(sorted(self.mesh.sizes.items())).encode())
         h.update(self.config.compute_dtype.encode())
+        # precision keys the executable cache (ISSUE 14): an int8
+        # weight-quantized program and its f32 twin must never share a
+        # bucket entry (per-op precision rides in the pc tuples above)
+        h.update(getattr(self, "_quantized", "").encode())
         self._exec_digest_cache = h.hexdigest()[:16]
         return self._exec_digest_cache
 

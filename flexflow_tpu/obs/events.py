@@ -37,6 +37,9 @@ EVENTS = {
     "serve_drain": "ServingEngine.drain() began",
     "serve_drain_abandoned": "drain timeout twice over: dispatcher "
                              "wedged in-flight, daemon thread abandoned",
+    "quantize_weights": "FFModel.quantize_weights(): eligible kernels "
+                        "replaced by int8 + per-channel scales "
+                        "(bytes before/after, max-abs-error vs bound)",
     "serve_dispatch_error": "one poisoned packed dispatch failed its "
                             "futures (engine keeps serving)",
     # ---- serving (generation) ----------------------------------------

@@ -22,7 +22,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 from ..config import DeviceType, MemoryType
 from ..initializers import GlorotUniform, ZeroInitializer
 from ..op import Op, OpContext, OpType
-from .common import apply_activation, cast_compute
+from .common import F32, apply_activation, cast_compute, dequant_matmul
 
 
 def host_placed(pc) -> bool:
@@ -82,9 +82,17 @@ class Linear(Op):
 
     def forward(self, params, inputs, ctx: OpContext):
         x = cast_compute(inputs[0], ctx)
-        k = cast_compute(params[self.w_kernel.name], ctx)
-        y = jnp.einsum("...i,oi->...o", x, k,
-                       preferred_element_type=jnp.float32)
+        k = params[self.w_kernel.name]
+        if k.dtype == jnp.int8:
+            # int8 weight-only serving path (FFModel.quantize_weights):
+            # per-output-channel dequant fused into the matmul — the
+            # resident weight is the int8 tensor, never an f32 copy
+            from .common import scale_param_name
+            y = dequant_matmul(x, k, params[scale_param_name(
+                self.w_kernel.name)], "...i,oi->...o")
+        else:
+            y = jnp.einsum("...i,oi->...o", x, cast_compute(k, ctx),
+                           preferred_element_type=jnp.float32)
         if self.use_bias:
             y = y + params[self.w_bias.name].astype(y.dtype)
         y = apply_activation(y, self.activation)
@@ -129,9 +137,9 @@ class Embedding(Op):
             # sequence mode (transformer token embedding): keep every
             # looked-up row — (n, s) ids -> (n, s, d)
             self.aggr = "none"
-            self._add_output(input_tensor.shape + (out_dim,), "float32")
+            self._add_output(input_tensor.shape + (out_dim,), F32)
         else:
-            self._add_output((n, out_dim), "float32")
+            self._add_output((n, out_dim), F32)
         self.w_table = self._add_weight(
             (num_entries, out_dim), kernel_initializer or GlorotUniform(),
             "table", sharded_dim=1)

@@ -86,7 +86,19 @@ class LayerNorm(Op):
                        if use_bias else None)
 
     def forward(self, params, inputs, ctx: OpContext):
-        xf = inputs[0].astype(jnp.float32)
+        x = inputs[0]
+        if self.w_scale is not None and self.w_bias is not None:
+            # fused single-pass Pallas kernel (ops/pallas_norm.py):
+            # default OFF behind the same tuned-table/VMEM gate as
+            # pallas_pool; bit-parity with the stock path below is
+            # pinned in tests/test_pallas_norm.py
+            from .pallas_norm import (fused_layernorm, supported,
+                                      use_pallas_norm)
+            if use_pallas_norm() and supported(x.shape, x.dtype):
+                y = fused_layernorm(x, None, params[self.w_scale.name],
+                                    params[self.w_bias.name], self.eps)
+                return [cast_compute(y, ctx)]
+        xf = x.astype(jnp.float32)
         mean = xf.mean(axis=-1, keepdims=True)
         var = xf.var(axis=-1, keepdims=True)
         y = (xf - mean) * jax.lax.rsqrt(var + self.eps)

@@ -207,12 +207,13 @@ def supported(x_shape, dtype, kernel, stride, padding) -> bool:
         return False
     if max(kernel) > _MAX_KERNEL:
         return False
+    from .common import dtype_itemsize
     b, h, w, c = x_shape
     oh, ow = _out_hw(h, w, kernel, stride, padding)
     if oh <= 0 or ow <= 0:
         return False
     cb = min(c, 128)
-    itemsize = jnp.dtype(dtype).itemsize
+    itemsize = dtype_itemsize(dtype)
     return _tile_bytes(h, w, oh, ow, kernel, stride, padding, cb, 1,
                        itemsize) <= _VMEM_BUDGET
 

@@ -120,6 +120,7 @@ def bench_graph(name: str, num_devices: int = 16, steps: int = 192,
 
     best, best_mesh, best_t = search(layers, num_devices, budget=budget,
                                      seed=seed, sim=sim)
+    from ..config import dtype_short as _dtype_short
     from .calibration import device_kind as _device_kind
     desc = (estimator.describe() if estimator is not None
             else {"estimator": "analytic", "calibration_digest": None})
@@ -128,6 +129,10 @@ def bench_graph(name: str, num_devices: int = 16, steps: int = 192,
         "num_ops": len(layers),
         "num_devices": num_devices,
         "device_kind": _device_kind(),
+        # the objective's dtype policy rides with the provenance stamp
+        # (ISSUE 14): rows simulated under different compute dtypes are
+        # different populations, exactly like device_kind
+        "precision_policy": _dtype_short(sim.compute_dtype),
         **desc,
         "proposal_steps": steps,
         "proposals_per_sec_full": round(full_cps * steps, 2),

@@ -498,7 +498,8 @@ class CostEstimator:
 
     def op_time(self, op, dims, spec: DeviceSpec, dtype_bytes: int = 2,
                 backward: bool = False, flash_attention=None,
-                compute_dtype: str = "bfloat16") -> float:
+                compute_dtype: str = "bfloat16",
+                precision: str = "") -> float:
         raise NotImplementedError
 
     def describe(self) -> Dict[str, Optional[str]]:
@@ -511,9 +512,11 @@ class AnalyticEstimator(CostEstimator):
     name = "analytic"
 
     def op_time(self, op, dims, spec, dtype_bytes=2, backward=False,
-                flash_attention=None, compute_dtype="bfloat16"):
+                flash_attention=None, compute_dtype="bfloat16",
+                precision=""):
         return op_compute_time(op, dims, spec, dtype_bytes, backward,
-                               flash_attention=flash_attention)
+                               flash_attention=flash_attention,
+                               precision=precision)
 
 
 class TableEstimator(AnalyticEstimator):
@@ -589,8 +592,23 @@ class TableEstimator(AnalyticEstimator):
         return hit[1] if backward else hit[0]
 
     def op_time(self, op, dims, spec, dtype_bytes=2, backward=False,
-                flash_attention=None, compute_dtype="bfloat16"):
-        base = op_compute_time(op, dims, spec, dtype_bytes, backward,
+                flash_attention=None, compute_dtype="bfloat16",
+                precision=""):
+        # The table is dtype-keyed (2008.01040's feature scheme): a
+        # per-op precision override reaches the lookup through
+        # ``compute_dtype`` (the simulator resolves the override's
+        # dtype NAME; ``dtype_bytes`` arrives as the SESSION width and
+        # the byte effect is applied here).  The analytic base
+        # deliberately takes NO precision rate factor — a dtype-keyed
+        # entry's measured/analytic ratio already embodies that dtype's
+        # rate physics (the harvest computed its analytic denominator
+        # without the factor), so charging it in the base too would
+        # double-count the f32 MXU penalty on exact-tier hits.
+        from .cost_model import precision_dtype_bytes
+        base = op_compute_time(op, dims, spec,
+                               precision_dtype_bytes(precision,
+                                                     dtype_bytes),
+                               backward,
                                flash_attention=flash_attention)
         return base * self._scale(op, dims, backward, compute_dtype)
 
@@ -653,14 +671,35 @@ class RidgeEstimator(CostEstimator):
         return np.linalg.solve(a, X.T @ y)
 
     def op_time(self, op, dims, spec, dtype_bytes=2, backward=False,
-                flash_attention=None, compute_dtype="bfloat16"):
+                flash_attention=None, compute_dtype="bfloat16",
+                precision=""):
         w = self._w_bwd if backward else self._w_fwd
         if w is None:
             return op_compute_time(op, dims, spec, dtype_bytes, backward,
-                                   flash_attention=flash_attention)
+                                   flash_attention=flash_attention,
+                                   precision=precision)
         import numpy as np
         phi = np.asarray(self._phi(op_features(op, dims)))
-        return float(math.exp(float(phi @ w))) * 1e-3  # ms -> s
+        t = float(math.exp(float(phi @ w))) * 1e-3  # ms -> s
+        if precision:
+            # the feature vector carries no dtype (2008.01040's set is
+            # dtype-free; the table KEY holds it) — without a correction
+            # every precision flip would cost delta == 0 and Metropolis
+            # would accept arbitrary pins the objective never evaluated.
+            # Thread the dtype physics through the ANALYTIC ratio of the
+            # pinned vs session-dtype rooflines (bytes + MXU rate); ""
+            # skips this branch, keeping the uncalibrated/unpinned path
+            # bit-identical.
+            pinned = op_compute_time(op, dims, spec, dtype_bytes,
+                                     backward,
+                                     flash_attention=flash_attention,
+                                     precision=precision)
+            session = op_compute_time(op, dims, spec, dtype_bytes,
+                                      backward,
+                                      flash_attention=flash_attention)
+            if session > 0:
+                t *= pinned / session
+        return t
 
     def describe(self):
         return {"estimator": self.name,

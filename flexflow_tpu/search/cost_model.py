@@ -78,14 +78,49 @@ _VPU_OPS = {
 }
 
 
+def precision_dtype_bytes(precision: str, default: int) -> int:
+    """Activation byte width of one op under a strategy's precision
+    token: ``""`` follows the session dtype (``default`` — the
+    bit-identical path), ``"bf16"``/``"f32"`` force 2/4.  THE one
+    precision→bytes rule shared by the time roofline, the FF108/FF121
+    memory accounting and the SimSession's incremental cache."""
+    if precision == "bf16":
+        return 2
+    if precision == "f32":
+        return 4
+    return default
+
+
+# f32 matmuls run the MXU at half its bf16 rate (each f32 multiply
+# occupies two bf16 passes through the systolic array); VPU ops are
+# rate-flat across dtypes (their cost moves through the BYTES term).
+# The rate factor is charged ONE-SIDED by design: only an EXPLICIT
+# "f32" pin pays it, while the "" default keeps the session's legacy
+# dtype-blind full rate — the bit-identity contract (default policy ==
+# HEAD everywhere) forbids re-rating unpinned ops, so in an f32
+# session a bf16 pin is credited its bytes but NOT the 2x MXU rate it
+# would really gain.  The understatement is conservative (searched
+# mixed strategies can only be better on silicon than simulated, never
+# worse); the calibrated estimators recover the real differential
+# through their dtype-keyed measurements.
+_F32_MXU_SCALE = 0.5
+
+
 def op_compute_time(op: Op, part_degrees: Tuple[int, ...],
                     spec: DeviceSpec = DEFAULT_SPEC,
                     dtype_bytes: int = 2, backward: bool = False,
-                    flash_attention=None) -> float:
+                    flash_attention=None, precision: str = "") -> float:
     """Roofline time for ONE partition of ``op`` under the given degrees:
     max(compute, memory) + launch overhead.  Backward ~= 2x forward FLOPs
     (dgrad + wgrad), matching the reference's separate bwdData/bwdFilter
-    measurement."""
+    measurement.
+
+    ``precision`` is the op's strategy-level dtype override (ISSUE 14,
+    ``ParallelConfig.precision``): ``"bf16"``/``"f32"`` charge the op's
+    activation traffic at 2/4 bytes and run MXU ops at full/half rate;
+    the default ``""`` leaves every term exactly as the caller's
+    ``dtype_bytes`` implies — bit-identical to a build without the
+    precision axis."""
     nparts = 1
     for d in part_degrees:
         nparts *= d
@@ -94,6 +129,9 @@ def op_compute_time(op: Op, part_degrees: Tuple[int, ...],
         flops *= 2.0
     peak = spec.vpu_flops if op.op_type in _VPU_OPS else spec.mxu_flops
     peak *= op.mxu_efficiency()
+    if precision == "f32" and op.op_type not in _VPU_OPS:
+        peak *= _F32_MXU_SCALE
+    dtype_bytes = precision_dtype_bytes(precision, dtype_bytes)
     io_bytes = 0
     for t in list(op.inputs) + list(op.outputs):
         io_bytes += t.volume * dtype_bytes

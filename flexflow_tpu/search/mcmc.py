@@ -20,11 +20,13 @@ leaves hybrid optima unreachable from a pure-DP start.
 
 from __future__ import annotations
 
+import dataclasses
 import math
 import random
 import warnings
 from typing import Dict, List, Optional, Tuple
 
+from ..analysis.legality import allowed_precisions
 from ..analysis.legality import per_dim_degrees as _per_dim_degrees
 from ..config import FFConfig, ParallelConfig
 from ..op import Op
@@ -176,7 +178,8 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
            opt_slot_bytes=_UNSET, sparse_tables=_UNSET,
            estimator=_UNSET,
            sim: Optional[Simulator] = None, chains: int = 1,
-           fixed_mesh: Optional[MeshShape] = None
+           fixed_mesh: Optional[MeshShape] = None,
+           precision_axis: bool = False
            ) -> Tuple[Dict[str, ParallelConfig], MeshShape, float]:
     """Run the annealing loop; returns (best strategies, best mesh
     factorization, best simulated time).  ``devices_per_slice`` < the
@@ -198,7 +201,18 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
     mutates per-op strategies on that mesh (no refactorization proposals,
     seeds drawn from it alone).  The reshard path uses this when the
     caller chose the mesh explicitly, so the returned strategies are
-    always expressible on the mesh that will actually be installed."""
+    always expressible on the mesh that will actually be installed.
+
+    ``precision_axis`` grows the SOAP space with the per-op precision
+    axis (ISSUE 14): ~1/4 of non-refactorization proposals flip one
+    op's ``ParallelConfig.precision`` among the tokens
+    ``analysis.legality.allowed_precisions`` permits (loss and
+    norm-statistics ops stay pinned fp32 — the same predicate the FF140
+    verifier pass enforces, so the walk never proposes a strategy lint
+    rejects), and partitioning mutations carry the op's current
+    precision along.  OFF by default: the rng draw sequence — and
+    therefore every acceptance decision — is bit-identical to a build
+    without the axis."""
     # one (name, value) table serves both branches: the contradiction
     # check against a shared sim AND the pass-through construction —
     # a new Simulator-mirrored kwarg is added in exactly one place
@@ -365,6 +379,19 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
                         continue
                     proposal = rng.choice(mesh_seeds(new_mesh))[0]
                     prop_mesh = new_mesh
+                elif precision_axis and rng.random() < 0.25:
+                    # precision mutation (ISSUE 14): flip one op's dtype
+                    # among its legal tokens, partitioning untouched
+                    op = rng.choice(layers)
+                    cur_pc = cur[op.name]
+                    opts = [p for p in allowed_precisions(op)
+                            if p != cur_pc.precision]
+                    if not opts:
+                        continue
+                    proposal = dict(cur)
+                    proposal[op.name] = dataclasses.replace(
+                        cur_pc, precision=rng.choice(opts))
+                    prop_mesh = ms_cur
                 else:
                     op = rng.choice(layers)
                     choices = cands(op, ms_cur)
@@ -373,6 +400,11 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
                     new_cfg = rng.choice(choices)
                     if new_cfg.dims == cur[op.name].dims:
                         continue
+                    if precision_axis and cur[op.name].precision:
+                        # a partitioning mutation must not silently
+                        # reset the op's precision to the default
+                        new_cfg = dataclasses.replace(
+                            new_cfg, precision=cur[op.name].precision)
                     proposal = dict(cur)
                     proposal[op.name] = new_cfg
                     prop_mesh = ms_cur
@@ -491,7 +523,8 @@ def optimize_strategies(model, cfg: FFConfig, num_devices: int = None,
         devices_per_slice=dps, remat=cfg.remat,
         compute_dtype=cfg.compute_dtype, conv_layout=layout,
         opt_slot_bytes=slot_bytes, sparse_tables=sparse_tables,
-        chains=cfg.search_chains, fixed_mesh=mesh_shape, **extra)
+        chains=cfg.search_chains, fixed_mesh=mesh_shape,
+        precision_axis=cfg.search_precision, **extra)
     calib_note = (f", estimator {est.name} "
                   f"(calibration {calib_table.digest})"
                   if est is not None and calib_table is not None else "")

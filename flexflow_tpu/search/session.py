@@ -383,7 +383,7 @@ class SimSession:
         (op, dims, stack, host)."""
         from ..ops.linear import host_placed
         from ..parallel.mesh import dim_axis_names
-        from .cost_model import op_memory_bytes
+        from .cost_model import op_memory_bytes, precision_dtype_bytes
         out = op.outputs[0]
         if pc is None:
             dims = tuple(ParallelConfig.data_parallel(
@@ -392,11 +392,18 @@ class SimSession:
             dims = pad_degrees(pc.dims, out.num_dims)
         stack = {a: (mesh_shape or {}).get(a, 1) for a in ("e", "p")}
         host = host_placed(pc)
-        key = (op.name, dims, stack["e"], stack["p"], host)
+        # the op's strategy precision changes its activation byte width
+        # (ISSUE 14) — part of the cache key, and the same
+        # effective_precision + precision_dtype_bytes rules the one-shot
+        # peak_memory_bytes applies, so session and one-shot sums stay
+        # bit-identical
+        precision = self.sim.effective_precision(pc)
+        key = (op.name, dims, stack["e"], stack["p"], host, precision)
         hit = self._mem_cache.get(key)
         if hit is None:
             hit = op_memory_bytes(
-                op, dims, self.sim.dtype_bytes,
+                op, dims,
+                precision_dtype_bytes(precision, self.sim.dtype_bytes),
                 opt_slot_bytes=self.sim.opt_slot_bytes,
                 axes=dim_axis_names(out.num_dims), stack_degrees=stack,
                 remat=False, act_scale=1.0,

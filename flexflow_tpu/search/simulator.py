@@ -119,13 +119,36 @@ class Simulator:
             self._native = load_ffsim()
 
     # --------------------------------------------------------------
-    def _op_time(self, op: Op, dims: Tuple[int, ...], backward: bool) -> float:
+    def effective_precision(self, pc) -> str:
+        """The op's strategy precision token, normalized against the
+        session dtype: an explicit pin EQUAL to ``compute_dtype``
+        traces to the exact same program as the "" default, so it must
+        cost the same too — without the normalization an 'f32' pin in
+        an f32 session would be charged the half-MXU-rate penalty for
+        a program identical to its unpinned twin (and measure mode
+        would re-microbenchmark it under a different cache key)."""
+        precision = getattr(pc, "precision", "") if pc is not None else ""
+        from ..config import PRECISION_DTYPES
+        if PRECISION_DTYPES.get(precision) == self.compute_dtype:
+            return ""
+        return precision
+
+    def _op_time(self, op: Op, dims: Tuple[int, ...], backward: bool,
+                 precision: str = "") -> float:
+        """Per-partition op time.  ``precision`` is the op's strategy
+        dtype override (ParallelConfig.precision): the measure path
+        times the op in that dtype, the estimator path keys the
+        dtype-keyed calibration table with it, and the analytic path
+        charges dtype-dependent rate + traffic (op_compute_time).  The
+        default ``""`` reproduces every path bit-identically."""
         if self.measure:
-            key = (op.name, dims)
+            key = (op.name, dims) if not precision \
+                else (op.name, dims, precision)
             if key not in self._measure_cache:
                 import time as _time
                 t0 = _time.perf_counter()
-                self._measure_cache[key] = self._measure_op(op, dims)
+                self._measure_cache[key] = self._measure_op(op, dims,
+                                                            precision)
                 if self.verbose_measure:
                     f, b = self._measure_cache[key]
                     print(f"# measure[{len(self._measure_cache)}] "
@@ -136,20 +159,31 @@ class Simulator:
             fwd, bwd = self._measure_cache[key]
             return bwd if backward else fwd
         if self.estimator is not None:
+            from ..config import PRECISION_DTYPES
+            # SESSION dtype_bytes + the raw precision token: each
+            # estimator resolves the override itself (analytic through
+            # op_compute_time's physics, table through the byte width +
+            # the dtype-keyed lookup, ridge through the analytic ratio)
+            # — passing pre-resolved bytes here would hide the session
+            # baseline the ridge ratio needs
             return self.estimator.op_time(
-                op, dims, self.spec, self.dtype_bytes, backward,
-                flash_attention=self.flash_attention,
-                compute_dtype=self.compute_dtype)
+                op, dims, self.spec, self.dtype_bytes,
+                backward, flash_attention=self.flash_attention,
+                compute_dtype=PRECISION_DTYPES.get(precision,
+                                                   self.compute_dtype),
+                precision=precision)
         return op_compute_time(op, dims, self.spec, self.dtype_bytes, backward,
-                               flash_attention=self.flash_attention)
+                               flash_attention=self.flash_attention,
+                               precision=precision)
 
-    def _measure_op(self, op: Op, dims: Tuple[int, ...]
-                    ) -> Tuple[float, float]:
+    def _measure_op(self, op: Op, dims: Tuple[int, ...],
+                    precision: str = "") -> Tuple[float, float]:
         """On-hardware microbenchmark of one op sub-shape -> (fwd_s, bwd_s)
         (reference Op::measure_compute_time).  Delegates to the calibrated
         profiler — real initializer values, bf16 compute, random inputs,
         slope timing, the run's flash flag (VERDICT r3 #8: one timing path,
         not two) — on the per-partition shapes from ``Op.sub_problem``."""
+        from ..config import PRECISION_DTYPES
         from ..profiling import profile_op
 
         try:
@@ -157,7 +191,9 @@ class Simulator:
         except (AssertionError, ValueError):
             return (float("inf"),) * 2  # indivisible -> invalid config
         try:
-            r = profile_op(op, compute_dtype=self.compute_dtype,
+            r = profile_op(op,
+                           compute_dtype=PRECISION_DTYPES.get(
+                               precision, self.compute_dtype),
                            flash_attention=self.flash_attention,
                            input_shapes=in_shapes, weight_shapes=w_shapes,
                            conv_layout=self.conv_layout)
@@ -168,9 +204,11 @@ class Simulator:
         if not np.isfinite(fwd):
             # no float leaf to time on (int-only view op): analytic numbers
             fwd = op_compute_time(op, dims, self.spec, self.dtype_bytes,
-                                  False, flash_attention=self.flash_attention)
+                                  False, flash_attention=self.flash_attention,
+                                  precision=precision)
             bwd = op_compute_time(op, dims, self.spec, self.dtype_bytes,
-                                  True, flash_attention=self.flash_attention)
+                                  True, flash_attention=self.flash_attention,
+                                  precision=precision)
         elif not np.isfinite(bwd) or bwd <= 0.0:
             bwd = 2.0 * fwd  # non-differentiable op: analytic bwd ~= 2x fwd
         return fwd, bwd
@@ -193,8 +231,10 @@ class Simulator:
         # changes the sync cost
         host = host_placed(pc)
         sparse_tables = frozenset() if host else self.sparse_tables
+        precision = self.effective_precision(pc)
         key = (op.name, None if pc is None
-               else (tuple(pc.dims), tuple(pc.device_ids), host))
+               else (tuple(pc.dims), tuple(pc.device_ids), host,
+                     precision))
         hit = self._plan_cache.get(key)
         if hit is not None:
             return hit
@@ -204,8 +244,8 @@ class Simulator:
                 min(self.num_devices, op.outputs[0].shape[0]), nd)
         out = op.outputs[0]
         dims = pad_degrees(pc.dims, out.num_dims)
-        ft = self._op_time(op, dims, backward=False)
-        bt = self._op_time(op, dims, backward=True)
+        ft = self._op_time(op, dims, backward=False, precision=precision)
+        bt = self._op_time(op, dims, backward=True, precision=precision)
         sync = 0.0
         if op.weights:
             from ..parallel.mesh import dim_axis_names
@@ -277,6 +317,7 @@ class Simulator:
         if remat:
             n_mat = max(1, len(layers))
             act_scale = min(1.0, 2.0 / math.sqrt(n_mat))
+        from .cost_model import precision_dtype_bytes
         total = float(extra_state_bytes)
         for op in layers:
             pc = strategies.get(op.name)
@@ -287,15 +328,22 @@ class Simulator:
             else:
                 dims = pad_degrees(pc.dims, out.num_dims)
             # host-placed candidates run the dense path — no sparse
-            # row-grad discount on their tables (mirrors _op_plan)
-            total += op_memory_bytes(op, dims, self.dtype_bytes,
-                                     opt_slot_bytes=self.opt_slot_bytes,
-                                     axes=dim_axis_names(out.num_dims),
-                                     stack_degrees=stack, remat=remat,
-                                     act_scale=act_scale,
-                                     sparse_tables=(frozenset()
-                                                    if host_placed(pc)
-                                                    else self.sparse_tables))
+            # row-grad discount on their tables (mirrors _op_plan).
+            # Activation bytes follow the op's strategy precision
+            # (ISSUE 14): a bf16-pinned op's retained outputs cost 2
+            # bytes/elem even in an f32 session; "" (and a pin equal to
+            # the session dtype — effective_precision) keeps the session
+            # dtype — the FF108 scalar is bit-identical without overrides
+            total += op_memory_bytes(
+                op, dims,
+                precision_dtype_bytes(self.effective_precision(pc),
+                                      self.dtype_bytes),
+                opt_slot_bytes=self.opt_slot_bytes,
+                axes=dim_axis_names(out.num_dims),
+                stack_degrees=stack, remat=remat,
+                act_scale=act_scale,
+                sparse_tables=(frozenset() if host_placed(pc)
+                               else self.sparse_tables))
         return total
 
     def memory_timeline(self, layers: List[Op],
@@ -345,6 +393,7 @@ class Simulator:
         # always-resident extra state (e.g. the generation engine's KV
         # cache via analysis.kv_memory) rides in state_bytes so the
         # timeline's high-water and FF108's scalar see the same number
+        from .cost_model import precision_dtype_bytes
         state_total = float(extra_state_bytes)
         acts: Dict[str, float] = {}
         cotangents: Dict[str, float] = {}
@@ -356,8 +405,13 @@ class Simulator:
                     min(self.num_devices, out.shape[0]), out.num_dims).dims)
             else:
                 dims = pad_degrees(pc.dims, out.num_dims)
+            # per-op dtype bytes (ISSUE 14): the same precision rule the
+            # FF108 scalar charges, so the FF121 timeline and the gate
+            # cannot disagree about a mixed-precision strategy
+            op_bytes = precision_dtype_bytes(self.effective_precision(pc),
+                                             self.dtype_bytes)
             state, act = op_memory_components(
-                op, dims, self.dtype_bytes,
+                op, dims, op_bytes,
                 opt_slot_bytes=self.opt_slot_bytes,
                 axes=dim_axis_names(out.num_dims), stack_degrees=stack,
                 remat=remat, act_scale=act_scale,
@@ -369,7 +423,7 @@ class Simulator:
             for d in dims:
                 nparts *= d
             cotangents[op.name] = sum(
-                t.volume * self.dtype_bytes / max(1, nparts)
+                t.volume * op_bytes / max(1, nparts)
                 for t in op.outputs)
 
         events: List[Dict] = []

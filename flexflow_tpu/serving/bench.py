@@ -381,6 +381,7 @@ def run_overload_bench(requests: int = 512, rows_lo: int = 1,
         "bench": "serve-overload",
         "backend": jax.default_backend(),
         "device_kind": dk,
+        "precision_policy": model.config.precision_policy(),
         "comm_plan_digest": comm_plan_digest_for_model(model),
         "estimator": "measured",
         "config": {
@@ -453,6 +454,10 @@ def run_serve_bench(requests: int = 512, rows_lo: int = 1, rows_hi: int = 8,
         "bench": "serve-bench",
         "backend": jax.default_backend(),
         "device_kind": _device_kind(),
+        # the serving precision policy next to the provenance stamp
+        # (ISSUE 14): int8-quantized and full-precision rows are
+        # different populations
+        "precision_policy": model.config.precision_policy(),
         # which sharding/communication plan served these rows (the
         # static plan digest from flexflow-tpu explain): rows measured
         # under different plans are different populations

@@ -215,6 +215,22 @@ class ServingEngine:
         self._n_inputs = len(model.input_tensors)
         self._in_dtypes = [t.dtype for t in model.input_tensors]
         self._in_shapes = [tuple(t.shape[1:]) for t in model.input_tensors]
+        # int8 weight-only quantization (docs/serving.md): applied at
+        # engine WARMUP so the bucket executables below lower against
+        # the quantized params, with the symmetric-rounding quality
+        # bound checked before anything serves — a violating table
+        # means the quantizer is broken, and refusing to start beats
+        # silently serving garbage
+        self.quantize = str(getattr(cfg, "serve_quantize", "") or "")
+        if self.quantize:
+            qrep = model.quantize_weights(self.quantize)
+            if not qrep["bound_ok"]:
+                raise RuntimeError(
+                    f"int8 quantization quality bound violated at "
+                    f"warmup: max_abs_err {qrep['max_abs_err']:.3e} > "
+                    f"bound {qrep['error_bound']:.3e} "
+                    f"({len(qrep['weights'])} weight(s)); refusing to "
+                    f"serve")
         # pay every bucket's AOT compile up front; the executables live
         # in model._fwd_compiled (the same cache predict() uses, so a
         # model re-compile() is followed, never served stale) — the
@@ -634,7 +650,8 @@ class ServingEngine:
                 "health": self.health,
                 "admission": self.admission,
                 "max_queue_rows": self.max_queue_rows,
-                "peak_queue_rows": self._batcher.peak_rows}
+                "peak_queue_rows": self._batcher.peak_rows,
+                "quantize": self.quantize}
 
     # ---- fault injection (FF_FAULT serve_* kinds) ----------------------
     def _fire_serve_faults(self) -> None:

@@ -113,11 +113,25 @@ def model_residency(spec: TenantSpec, layers, input_tensors, strategies,
     peak = sim.peak_memory_bytes(layers, strategies or {}, mesh_shape,
                                  assume_remat=False) * factor
     params = static_params_bytes(layers, strategies, mesh)
+    quant_delta = 0.0
+    if getattr(spec, "quantize", "") == "int8":
+        # int8 weight-quantized tenant (ISSUE 14): the eligible f32
+        # kernel shards are replaced by int8 shards + replicated
+        # per-channel scales — the SAME eligibility predicate and
+        # placement rules quantize_params applies at engine warmup,
+        # so resident_bytes stays pinned byte-for-byte against the
+        # engine's real allocation
+        from ..quantize import quantized_params_bytes_delta
+        quant_delta = quantized_params_bytes_delta(layers, strategies,
+                                                   mesh)
+        params += quant_delta
     return {
         "name": spec.name,
         "engine": spec.engine,
         "mesh": {a: s for a, s in mesh_shape.items() if s > 1} or {"n": 1},
         "params_bytes": params,
+        "quantize": getattr(spec, "quantize", ""),
+        "quantize_bytes_delta": quant_delta,
         "kv_bytes": kv,
         "kv_slots": slots,
         "kv_seq": seq,
@@ -125,8 +139,10 @@ def model_residency(spec: TenantSpec, layers, input_tensors, strategies,
         "resident_bytes": params + kv,
         # the gate quantity: FF108 accounting + the unscaled KV scalar
         # (a preallocated buffer has no XLA temps — same rule as the
-        # single-model lint --serve-slots path)
-        "ff108_bytes": peak + kv,
+        # single-model lint --serve-slots path).  The quantization
+        # delta rides UNSCALED too, like the KV cache: an int8 buffer
+        # swap has no XLA-temp component.
+        "ff108_bytes": peak + kv + quant_delta,
     }
 
 

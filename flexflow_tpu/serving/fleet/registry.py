@@ -68,10 +68,32 @@ class TenantSpec:
     weight: float = 1.0
     qps_rows: float = 0.0
     batch_size: int = 0
+    # "" = full precision; "int8" = weight-only quantized serving
+    # (dense tenants only — FFModel.quantize_weights at engine warmup;
+    # the co-residency gate accounts the int8 footprint byte-for-byte)
+    quantize: str = ""
     serve: Dict = dataclasses.field(default_factory=dict)
     generation: Dict = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
+        if "quantize" in self.serve:
+            # quantize rides ONLY as the top-level TenantSpec field:
+            # smuggled through the serve{} pass-through it would reach
+            # the engine (cfg.serve_quantize) while the co-residency
+            # gate — which keys on spec.quantize — still predicted f32
+            # bytes, breaking the byte-for-byte pin
+            raise ValueError(
+                f"tenant {self.name!r}: put quantize at the tenant "
+                f"level, not inside serve{{}}")
+        if self.quantize not in ("", "int8"):
+            raise ValueError(
+                f"tenant {self.name!r}: quantize must be '' or 'int8', "
+                f"got {self.quantize!r}")
+        if self.quantize and self.engine != "dense":
+            raise ValueError(
+                f"tenant {self.name!r}: quantize applies to dense "
+                f"tenants only (generation decode caches are not "
+                f"weight-quantized)")
         if self.engine not in ENGINE_KINDS:
             raise ValueError(
                 f"tenant {self.name!r}: engine must be one of "
@@ -134,6 +156,11 @@ def validate_fleet_json(obj) -> List[str]:
         for key, want in (("checkpoint", str), ("strategy", str)):
             if key in e and not isinstance(e[key], want):
                 probs.append(f"{where}: {key} must be a string")
+        if "quantize" in e and e["quantize"] not in ("", "int8"):
+            probs.append(f"{where}: quantize must be '' or 'int8'")
+        if e.get("quantize") and kind != "dense":
+            probs.append(f"{where}: quantize applies to dense tenants "
+                         f"only")
         for key in ("weight", "qps_rows"):
             if key in e and not isinstance(e[key], (int, float)):
                 probs.append(f"{where}: {key} must be a number")
@@ -203,6 +230,7 @@ class ModelRegistry:
                 weight=float(e.get("weight", 1.0)),
                 qps_rows=float(e.get("qps_rows", 0.0)),
                 batch_size=int(e.get("batch_size", 0)),
+                quantize=str(e.get("quantize", "")),
                 serve=dict(e.get("serve", {})),
                 generation=dict(e.get("generation", {})))
         return reg
@@ -255,6 +283,9 @@ def _tenant_config(spec: TenantSpec):
     cfg = FFConfig(compute_dtype="float32")
     if spec.batch_size:
         cfg.batch_size = spec.batch_size
+    if spec.quantize:
+        # the ServingEngine quantizes at warmup when this is set
+        cfg.serve_quantize = spec.quantize
     for k, v in spec.serve.items():
         attr = "serve_" + k
         if hasattr(cfg, attr):

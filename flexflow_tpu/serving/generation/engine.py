@@ -326,6 +326,17 @@ class GenerationEngine:
         assert model._compiled, "compile() + init_layers() the model first"
         _enable_compile_cache()
         cfg = model.config
+        if getattr(cfg, "serve_quantize", "") or \
+                getattr(model, "_quantized", ""):
+            # weight quantization is a DENSE-serving feature (the fleet
+            # schema rejects it on generation tenants for the same
+            # reason): silently serving full-precision weights while
+            # the operator budgets HBM for int8 would overcommit the
+            # KV+weight capacity plan
+            raise ValueError(
+                "serve_quantize is not supported by the generation "
+                "engine (weight quantization covers dense serving "
+                "only); unset FFConfig.serve_quantize for this model")
         self.model = model
         self.slots = int(slots or cfg.serve_gen_slots)
         seq_len = (model.input_tensors[0].shape[1]

@@ -7,8 +7,17 @@ logic in ``src/runtime/strategy.cc:87-163``.  Message layout:
                  required DeviceType device_type = 2;   // GPU=0, CPU=1
                  repeated int32 dims = 3;               // innermost-first!
                  repeated int32 device_ids = 4;
-                 repeated MemoryType memory_types = 5; }
+                 repeated MemoryType memory_types = 5;
+                 optional Precision precision = 6; }    // TPU extension
     message Strategy { repeated Op ops = 1; }
+
+The ``precision`` field (6) is the flexflow-tpu extension carrying the
+SOAP precision axis (ISSUE 14): 0 = FOLLOW (the op runs in
+``FFConfig.compute_dtype`` — also what every pre-extension ``.pb``
+parses as, since proto2 omits absent optionals), 1 = BF16, 2 = F32.
+The writer emits the field only when it is non-default, so a strategy
+without overrides round-trips to the exact bytes an old writer
+produced (``strategy_digest`` unchanged).
 
 We hand-roll the proto2 wire format (varints + length-delimited fields) so
 existing ``.pb`` strategy files parse without a protobuf runtime dependency.
@@ -28,6 +37,10 @@ from ..config import DeviceType, MemoryType, ParallelConfig
 
 _WIRE_VARINT = 0
 _WIRE_LEN = 2
+
+# Op.precision wire enum (field 6) <-> ParallelConfig.precision token
+_PRECISION_FROM_WIRE = {0: "", 1: "bf16", 2: "f32"}
+_PRECISION_TO_WIRE = {"": 0, "bf16": 1, "f32": 2}
 
 
 class StrategyParseError(ValueError):
@@ -106,6 +119,7 @@ def _parse_op(data: bytes, base: int = 0) -> Tuple[str, ParallelConfig]:
     dims: List[int] = []
     device_ids: List[int] = []
     memory_types: List[int] = []
+    precision = 0
     while pos < len(buf):
         tag, pos = _read_varint(buf, pos, base, "Op tag")
         field, wire = tag >> 3, tag & 7
@@ -132,6 +146,14 @@ def _parse_op(data: bytes, base: int = 0) -> Tuple[str, ParallelConfig]:
         elif field == 5:
             pos = _parse_repeated_int32(buf, pos, wire, memory_types, base,
                                         "Op.memory_types")
+        elif field == 6:
+            at = pos
+            precision, pos = _read_varint(buf, pos, base, "Op.precision")
+            if precision not in _PRECISION_FROM_WIRE:
+                raise StrategyParseError(
+                    f"strategy file byte {base + at}: op {name!r}: "
+                    f"unknown Op.precision value {precision} (want 0="
+                    f"follow, 1=bf16, 2=f32)")
         else:  # skip unknown
             fld = f"unknown field {field}"
             if wire == _WIRE_VARINT:
@@ -148,6 +170,7 @@ def _parse_op(data: bytes, base: int = 0) -> Tuple[str, ParallelConfig]:
             device_ids=tuple(device_ids) or tuple(
                 range(max(1, _prod(dims)))),
             memory_types=tuple(MemoryType(m) for m in memory_types),
+            precision=_PRECISION_FROM_WIRE[precision],
         )
     except ValueError as e:  # bad enum value: say which op, keep offset
         raise StrategyParseError(
@@ -209,6 +232,13 @@ def dumps(strategies: Dict[str, ParallelConfig]) -> bytes:
         for m in pc.memory_types:
             _write_varint(op, (5 << 3) | _WIRE_VARINT)
             _write_varint(op, int(m))
+        # emitted only when non-default: a strategy without precision
+        # overrides round-trips byte-identically to a pre-extension
+        # writer (strategy_digest and shipped .pbs unchanged)
+        prec = _PRECISION_TO_WIRE[getattr(pc, "precision", "")]
+        if prec:
+            _write_varint(op, (6 << 3) | _WIRE_VARINT)
+            _write_varint(op, prec)
         body = op.getvalue()
         _write_varint(top, (1 << 3) | _WIRE_LEN)
         _write_varint(top, len(body))

@@ -31,15 +31,16 @@ from typing import Dict
 import numpy as np
 
 
-def _build_model(k: int, batch_size: int, hidden: int, seed: int):
+def _build_model(k: int, batch_size: int, hidden: int, seed: int,
+                 compute_dtype: str = "float32"):
     """Dispatch-bound small model: two dense layers on a tiny batch —
     per-step compute is ~10s of microseconds, so per-step host work
     dominates at K=1."""
     import flexflow_tpu as ff
     from flexflow_tpu.parallel.mesh import MachineMesh
 
-    cfg = ff.FFConfig(batch_size=batch_size, compute_dtype="float32",
-                      seed=seed)
+    cfg = ff.FFConfig(batch_size=batch_size,
+                      compute_dtype=compute_dtype, seed=seed)
     cfg.steps_per_dispatch = k
     m = ff.FFModel(cfg, mesh=MachineMesh({"n": 1}))
     x = m.create_tensor((batch_size, 16), name="x")
@@ -59,7 +60,8 @@ def _data(steps: int, batch_size: int, seed: int):
 
 
 def bench_k(k: int, steps: int = 64, batch_size: int = 32,
-            epochs: int = 4, hidden: int = 64, seed: int = 0) -> Dict:
+            epochs: int = 4, hidden: int = 64, seed: int = 0,
+            compute_dtype: str = "float32") -> Dict:
     """steps/s of ``fit()`` at ``steps_per_dispatch=k`` — warm epoch
     first (pays the XLA compile for the fused-K program), then
     ``epochs`` timed epochs fenced by fit()'s own end-of-run
@@ -68,7 +70,8 @@ def bench_k(k: int, steps: int = 64, batch_size: int = 32,
 
     from flexflow_tpu.analysis import comm_plan_digest_for_model
 
-    model = _build_model(k, batch_size, hidden, seed)
+    model = _build_model(k, batch_size, hidden, seed,
+                         compute_dtype=compute_dtype)
     plan_digest = comm_plan_digest_for_model(model)
     x, y = _data(steps, batch_size, seed)
     model.warmup_compile(x[:batch_size], y[:batch_size])
@@ -90,6 +93,10 @@ def bench_k(k: int, steps: int = 64, batch_size: int = 32,
         # static plan digest from flexflow-tpu explain — rows with
         # different plans are different populations, like device_kind)
         "comm_plan_digest": plan_digest,
+        # the run's precision policy, next to device_kind/
+        # calibration_digest (ISSUE 14 CI satellite): rows measured
+        # under different dtype policies are different populations
+        "precision_policy": model.config.precision_policy(),
     }
 
 
@@ -166,6 +173,8 @@ def main(argv=None) -> None:
         "steps_per_epoch": args.steps,
         "device_kind": kind,
         "calibration_digest": digest,
+        "precision_policy": (results[0].get("precision_policy")
+                             if results else None),
         "comm_plan_digest": (results[0].get("comm_plan_digest")
                              if results else None),
         "results": results,

@@ -79,6 +79,27 @@ def main() -> int:
         if rep is not None:
             for p in validate_explain_json(rep):
                 problems.append(f"{rel}: explain schema: {p}")
+        # precision-axis backward compatibility (ISSUE 14): every
+        # shipped .pb predates the Op.precision field — it must parse
+        # with precision == "" on every op AND re-serialize to the
+        # EXACT bytes on disk (the writer emits field 6 only when
+        # non-default, so pre-extension files round-trip unchanged and
+        # their strategy_digest is stable across the extension)
+        from flexflow_tpu.strategy.proto import dumps, load_strategy_file
+        with open(path, "rb") as f:
+            raw = f.read()
+        strategies = load_strategy_file(path)
+        bad_prec = [n for n, pc in strategies.items() if pc.precision]
+        if bad_prec:
+            problems.append(
+                f"{rel}: shipped strategy carries precision overrides "
+                f"{bad_prec[:4]} — pre-extension artifacts must read "
+                f"as default precision")
+        if dumps(strategies) != raw:
+            problems.append(
+                f"{rel}: loads->dumps is not byte-identical — the "
+                f"precision proto extension changed the wire encoding "
+                f"of a pre-extension file")
     for p in problems:
         print(p)
     if problems:

@@ -3,8 +3,9 @@ microbench verdict — the same measure-then-enable pipeline that retired
 ``_fast_max_pool`` (see decide_fast_kernels.py; reference counterpart:
 cuDNN algorithm find, src/ops/conv_2d.cu:864-922).
 
-Reads the newest ``microbench_pallas_pool_bwd_stem`` row from the
-microbench logs in ``artifacts/r5`` and writes the ``pallas_pool`` key
+Reads the newest ``microbench_pallas_pool_bwd_stem`` and
+``microbench_pallas_norm_res`` rows from the microbench logs in
+``artifacts/r5`` and writes the ``pallas_pool`` / ``pallas_norm`` keys
 of ``flexflow_tpu/tuned_defaults.json`` for this device kind: ON iff
 the measured stock/fast speedup clears 1.05 (5% margin — a tie keeps
 stock, which fuses with neighbors and has no Mosaic compile risk).
@@ -22,7 +23,7 @@ OUT = os.path.join(os.path.dirname(__file__), "..", "flexflow_tpu",
 MARGIN = 1.05
 
 
-def newest_row():
+def newest_row(metric="microbench_pallas_pool_bwd_stem"):
     best = None
     for path in glob.glob(os.path.join(R, "microbench*.log")):
         try:
@@ -31,7 +32,7 @@ def newest_row():
         except OSError:
             continue
         for line in lines:
-            if '"microbench_pallas_pool_bwd_stem"' not in line:
+            if f'"{metric}"' not in line:
                 continue
             try:
                 row = json.loads(line)
@@ -43,17 +44,19 @@ def newest_row():
     return best[0] if best else None
 
 
+# tuned-table flag -> the microbench metric that decides it (same
+# measure-then-enable pipeline for every Pallas kernel)
+FLAGS = {
+    "pallas_pool": "microbench_pallas_pool_bwd_stem",
+    "pallas_norm": "microbench_pallas_norm_res",
+}
+
+
 def main():
-    row = newest_row()
-    if row is None:
-        print("no pallas_pool microbench row; leaving defaults")
+    rows = {flag: newest_row(metric) for flag, metric in FLAGS.items()}
+    if all(r is None for r in rows.values()):
+        print("no pallas microbench rows; leaving defaults")
         return 0
-    print(row)
-    if row.get("value") is None:
-        print("pallas pool failed on chip (error row); pinning OFF")
-        on = False
-    else:
-        on = float(row["value"]) > MARGIN
 
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
     import jax
@@ -64,20 +67,35 @@ def main():
             table = json.load(f)
     except (OSError, ValueError):
         table = {}
-    table.setdefault("pallas_pool", {})[kind] = bool(on)
-    meta = table.setdefault("_meta", {}).setdefault(kind, {})
-    meta["pallas_pool"] = {
-        "decided_utc": time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime()),
-        "row": row,
-    }
+    pool_on = None
+    for flag, row in rows.items():
+        if row is None:
+            print(f"no {flag} microbench row; leaving its default")
+            continue
+        print(row)
+        if row.get("value") is None:
+            print(f"{flag} failed on chip (error row); pinning OFF")
+            on = False
+        else:
+            on = float(row["value"]) > MARGIN
+        if flag == "pallas_pool":
+            pool_on = on
+        table.setdefault(flag, {})[kind] = bool(on)
+        meta = table.setdefault("_meta", {}).setdefault(kind, {})
+        meta[flag] = {
+            "decided_utc": time.strftime("%Y-%m-%d %H:%M:%S",
+                                         time.gmtime()),
+            "row": row,
+        }
+        print(f"tuned_defaults[{flag}][{kind}] = {on}")
     with open(OUT, "w") as f:
         json.dump(table, f, indent=2, sort_keys=True)
         f.write("\n")
-    # verdict marker for the queue gate (run_if_pallas.sh) — carries the
-    # ACTUAL device kind so the gate never hardcodes one
-    with open(os.path.join(R, "pallas_verdict.json"), "w") as f:
-        json.dump({"kind": kind, "on": bool(on)}, f)
-    print(f"tuned_defaults[pallas_pool][{kind}] = {on}")
+    if pool_on is not None:
+        # verdict marker for the queue gate (run_if_pallas.sh) — carries
+        # the ACTUAL device kind so the gate never hardcodes one
+        with open(os.path.join(R, "pallas_verdict.json"), "w") as f:
+            json.dump({"kind": kind, "on": bool(pool_on)}, f)
     return 0
 
 

@@ -139,6 +139,42 @@ def pallas_pool_pair(stock_s):
               flush=True)
 
 
+def pallas_norm_pair():
+    """Transformer residual+LayerNorm: fused single-pass Pallas kernel
+    (ops/pallas_norm.py) vs the stock add + f32-stats norm — the shape
+    class the pipeline block's two ln(x + attn) sites run (b x s x d).
+    Decides the `pallas_norm` tuned-table flag (default OFF until this
+    measures a win on the device kind)."""
+    from flexflow_tpu.ops.pallas_norm import (fused_layernorm,
+                                              _ln_reference, supported)
+
+    x = jnp.ones((B, 128, 512), jnp.bfloat16)
+    r = jnp.ones((B, 128, 512), jnp.bfloat16)
+    s = jnp.ones((512,), jnp.float32)
+    b = jnp.ones((512,), jnp.float32)
+    if not supported(x.shape, x.dtype):
+        print(json.dumps({"metric": "microbench_pallas_norm_res",
+                          "value": None, "unit": "stock/fast speedup",
+                          "vs_baseline": None,
+                          "error": "shape not supported"}), flush=True)
+        return
+
+    def stock(v, w):
+        return _ln_reference(v, w, s, b, 1e-5)
+
+    def fast(v, w):
+        return fused_layernorm(v, w, s, b, 1e-5)
+
+    try:
+        row("pallas_norm_res", timed(stock, x, r), timed(fast, x, r))
+    except Exception as e:  # Mosaic lowering failures stay local
+        print(json.dumps({"metric": "microbench_pallas_norm_res",
+                          "value": None, "unit": "stock/fast speedup",
+                          "vs_baseline": None,
+                          "error": f"{type(e).__name__}: {e}"[:300]}),
+              flush=True)
+
+
 def concat_pair():
     """Channel concat between NHWC-internal convs: stock = concat in
     NCHW (boundary transposes), fast = lane-axis concat."""
@@ -161,6 +197,7 @@ def main():
                       "vs_baseline": None}), flush=True)
     stock_pool_s = pool_pair()
     pallas_pool_pair(stock_pool_s)
+    pallas_norm_pair()
     dgrad_pair()
     concat_pair()
     print("microbench models_ok", flush=True)

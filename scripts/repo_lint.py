@@ -87,6 +87,15 @@ by tier-1 ``tests/test_static_checks.py``).  Rules:
   itself), as is ``serving/bench.py`` — the benchmark harness
   DRIVES real wall-clock runs; it measures the engine, it is not the
   engine.
+* **RL012 — dtype resolution in op code happens in ONE place**
+  (ISSUE 14): inside ``flexflow_tpu/ops/`` (``ops/common.py`` — the
+  resolution point — exempt), a ``jnp.dtype(...)``/``np.dtype(...)``
+  call or a dtype STRING literal ("float32", "bfloat16", ...) is a
+  second dtype-policy site the per-op precision axis
+  (``resolve_op_dtype``/``cast_compute``) cannot see.  Symbolic dtypes
+  (``jnp.float32`` for pinned f32 accumulation/statistics) are the
+  sanctioned spelling of a *semantic* pin and stay legal; the rare
+  legitimate string/call site carries an ``RL012-ok:`` comment.
 * **RL011 — every emitted event name is declared in the registry**
   (ISSUE 13): a ``Category.event("name", ...)`` call site in
   ``flexflow_tpu/`` must pass a string literal declared in
@@ -192,6 +201,15 @@ _RL008_BANNED = {"time.time", "time.monotonic"}
 _RL008_EXEMPT = ("flexflow_tpu/serving/bench.py",
                  "flexflow_tpu/serving/fleet/bench.py")
 
+
+# RL012: dtype string literals banned in flexflow_tpu/ops/ outside the
+# one resolution module (ops/common.py) — string dtypes there bypass
+# the per-op precision axis's single resolution point
+_RL012_EXEMPT = ("flexflow_tpu/ops/common.py",)
+_RL012_DTYPE_STRINGS = {
+    "float16", "float32", "float64", "bfloat16",
+    "int8", "int16", "int32", "int64", "uint8", "bool",
+}
 
 # files where hardware-rate literals are the DESIGN (the device model
 # and the calibration table) — exempt from RL007
@@ -323,6 +341,10 @@ class _Visitor(ast.NodeVisitor):
             or relpath == "flexflow_tpu/parallel/sharding.py")
         self.in_tests = relpath.startswith("tests/")
         self.in_serving = relpath.startswith("flexflow_tpu/serving/")
+        # RL012: op modules resolve dtypes through ops/common.py only
+        self.in_ops_dtype_scope = (
+            relpath.startswith("flexflow_tpu/ops/")
+            and relpath not in _RL012_EXEMPT)
         self.in_generation = relpath.startswith(
             "flexflow_tpu/serving/generation/")
         self.in_clock_scope = (self.in_serving
@@ -358,8 +380,28 @@ class _Visitor(ast.NodeVisitor):
             self._check_step_sync(node, name)
             self._check_raw_mesh(node, name)
             self._check_clock(node, name)
+            self._check_dtype_call(node, name)
         self._check_event_name(node)
         self.generic_visit(node)
+
+    def _check_dtype_call(self, node: ast.Call, name: str) -> None:
+        """RL012 (call half): jnp.dtype()/np.dtype() in op modules is a
+        second dtype-resolution site — route through ops/common.py
+        (resolve_op_dtype / cast_compute / dtype_itemsize)."""
+        if not self.in_ops_dtype_scope:
+            return
+        if name in ("jnp.dtype", "np.dtype", "numpy.dtype",
+                    "jax.numpy.dtype"):
+            line = (self.lines[node.lineno - 1]
+                    if 0 < node.lineno <= len(self.lines) else "")
+            if "RL012-ok" not in line:
+                self._add(node, "RL012",
+                          f"{name}() in flexflow_tpu/ops/ — dtype "
+                          f"resolution lives in ops/common.py only "
+                          f"(resolve_op_dtype/cast_compute/"
+                          f"dtype_itemsize), so the per-op precision "
+                          f"axis has ONE policy point; annotate "
+                          f"'RL012-ok: why' if this site is legitimate")
 
     def _check_event_name(self, node: ast.Call) -> None:
         """RL011: ``<logger>.event(<name>, ...)`` call sites in the
@@ -400,6 +442,17 @@ class _Visitor(ast.NodeVisitor):
 
     def visit_Constant(self, node: ast.Constant) -> None:
         v = node.value
+        if self.in_ops_dtype_scope and isinstance(v, str) \
+                and v in _RL012_DTYPE_STRINGS:
+            line = (self.lines[node.lineno - 1]
+                    if 0 < node.lineno <= len(self.lines) else "")
+            if "RL012-ok" not in line:
+                self._add(node, "RL012",
+                          f"dtype string literal {v!r} in "
+                          f"flexflow_tpu/ops/ — spell dtype policy "
+                          f"through ops/common.py (F32/BF16 constants, "
+                          f"resolve_op_dtype) or a symbolic jnp dtype; "
+                          f"annotate 'RL012-ok: why' if legitimate")
         if self.in_rate_scope and isinstance(v, (int, float)) \
                 and not isinstance(v, bool) \
                 and _RL007_LO <= abs(v) < _RL007_HI:

@@ -415,6 +415,36 @@ def test_static_checks_script_passes_on_repo():
      "from flexflow_tpu.fflogger import get_logger\n\ndef f():\n"
      "    get_logger('serve').event('totally_adhoc', x=1)\n",
      None),
+    # RL012: jnp.dtype() resolution in an op module bypasses the ONE
+    # precision-resolution point (ops/common.py)
+    ("flexflow_tpu/ops/zz_bad_dtype_call.py",
+     "import jax.numpy as jnp\n\ndef f(ctx):\n"
+     "    return jnp.dtype(ctx.compute_dtype)\n",
+     "RL012"),
+    # ...as does a raw dtype string literal
+    ("flexflow_tpu/ops/zz_bad_dtype_str.py",
+     "def f(x):\n    return x.astype('float32')\n",
+     "RL012"),
+    # ops/common.py IS the resolution point — exempt
+    ("flexflow_tpu/ops/common.py",
+     "import jax.numpy as jnp\n\ndef cast(x, ctx):\n"
+     "    return x.astype(jnp.dtype(ctx.compute_dtype))\n",
+     None),
+    # symbolic jnp dtypes are the sanctioned semantic-pin spelling
+    ("flexflow_tpu/ops/zz_ok_symbolic.py",
+     "import jax.numpy as jnp\n\ndef f(x):\n"
+     "    return x.astype(jnp.float32)\n",
+     None),
+    # the waiver comment admits the rare legitimate site
+    ("flexflow_tpu/ops/zz_ok_waived.py",
+     "import numpy as np\n\ndef f():\n"
+     "    return np.dtype('int8').itemsize  # RL012-ok: host-side size\n",
+     None),
+    # outside ops/ the rule does not engage
+    ("flexflow_tpu/zz_ok_outside_ops.py",
+     "import jax.numpy as jnp\n\ndef f(x):\n"
+     "    return x.astype(jnp.dtype('float32'))\n",
+     None),
 ])
 def test_repo_lint_rules(tmp_path, rel, src, code):
     """repo_lint unit check on synthetic files, laid out under tmp_path

@@ -34,7 +34,11 @@ def _rand_pc(rng: random.Random) -> ParallelConfig:
         device_type=rng.choice((DeviceType.DEVICE, DeviceType.HOST)),
         dims=dims,
         device_ids=ids or tuple(range(nparts)),
-        memory_types=mts)
+        memory_types=mts,
+        # the ISSUE 14 precision axis rides the same property suite:
+        # the 200-case round-trip and every-prefix truncation below
+        # exercise strategies with AND without the field
+        precision=rng.choice(("", "", "", "bf16", "f32")))
 
 
 def _rand_strategy(rng: random.Random) -> dict:
@@ -101,6 +105,10 @@ def test_packed_repeated_int32_parses():
             _write_varint(op, (field << 3) | 2)  # packed
             _write_varint(op, len(payload.getvalue()))
             op.write(payload.getvalue())
+        prec = {"": 0, "bf16": 1, "f32": 2}[s["op"].precision]
+        if prec:
+            _write_varint(op, (6 << 3) | 0)
+            _write_varint(op, prec)
         body = op.getvalue()
         top = io.BytesIO()
         _write_varint(top, (1 << 3) | 2)
@@ -164,6 +172,41 @@ def test_duplicate_op_names_rejected():
                                       device_ids=(0, 1))})
     with pytest.raises(StrategyParseError, match="duplicate.*'fc'"):
         loads(one + one)
+
+
+def test_precision_field_roundtrip_and_backcompat():
+    """ISSUE 14: field 6 round-trips; strategies WITHOUT overrides
+    serialize to the exact pre-extension bytes (no field 6 emitted), so
+    shipped .pbs and their strategy_digest are unchanged."""
+    pc = ParallelConfig(dims=(2, 1), device_ids=(0, 1))
+    pre_extension = dumps({"fc": pc})
+    # a pre-extension file parses with the default token
+    assert loads(pre_extension)["fc"].precision == ""
+    # ...and no field-6 tag (0x30) appears anywhere in the encoding
+    assert bytes([6 << 3]) not in pre_extension
+    for tok in ("bf16", "f32"):
+        pc_t = ParallelConfig(dims=(2, 1), device_ids=(0, 1),
+                              precision=tok)
+        blob = dumps({"fc": pc_t})
+        assert loads(blob)["fc"].precision == tok
+        assert len(blob) == len(pre_extension) + 2  # one tag+value byte pair
+
+
+def test_unknown_precision_enum_is_clear_error():
+    op = io.BytesIO()
+    nb = b"fc"
+    _write_varint(op, (1 << 3) | 2)
+    _write_varint(op, len(nb))
+    op.write(nb)
+    _write_varint(op, (6 << 3) | 0)
+    _write_varint(op, 9)  # no such precision token
+    body = op.getvalue()
+    top = io.BytesIO()
+    _write_varint(top, (1 << 3) | 2)
+    _write_varint(top, len(body))
+    top.write(body)
+    with pytest.raises(StrategyParseError, match="precision"):
+        loads(top.getvalue())
 
 
 def test_bad_enum_value_is_clear_error():
