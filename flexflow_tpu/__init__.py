@@ -32,8 +32,9 @@ from .op import Op, OpType
 from .optimizers import AdamOptimizer, Optimizer, SGDOptimizer
 from .parallel.mesh import MachineMesh
 from .serving import (DeadlineExceeded, GenerationCancelled,
-                      GenerationEngine, GenerationStream, OverloadError,
-                      ServingEngine, ServingError, SheddedError)
+                      GenerationEngine, GenerationStream, KVCacheExhausted,
+                      OverloadError, ServingEngine, ServingError,
+                      SheddedError)
 from .tensor import Parameter, Tensor
 
 __version__ = "0.2.0"

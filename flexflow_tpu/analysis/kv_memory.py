@@ -1,24 +1,45 @@
 """KV-cache memory accounting — ONE layout/byte source shared by the
-runtime and the static tools (ISSUE 11 satellite).
+runtime and the static tools (ISSUE 11 satellite; ISSUE 15 tentpole).
 
-The token-generation engine preallocates per-slot decode state:
-attention ops hold a K and a V cache of ``(slots, max_seq, heads,
-head_dim)`` each (heads sharded over the tensor-parallel ``c`` mesh
-axis, slots over the data axis ``n``), LSTM ops carry an f32 ``(h, c)``
-state pair of ``(slots, hidden)``.  That HBM is resident for the life
-of the engine — exactly the kind of allocation a static HBM gate must
-know about, so :func:`kv_cache_bytes` is consumed by
+Since ISSUE 15 the decode state is a **paged block pool**, not a dense
+``(slots, max_seq, ...)`` preallocation: each attention op holds a K and
+a V pool of ``(num_pages, page_size, heads, head_dim)`` (heads sharded
+over the tensor-parallel ``c`` mesh axis; pages are interchangeable, so
+the page dim is replicated — any slot may hold any page) and a per-slot
+page table of gather indices maps logical positions onto pages.  HBM
+therefore scales with *pages*, and shared-prefix reuse (the prefix trie
+in ``serving/generation/pages.py``) makes pages-in-use scale with LIVE
+tokens rather than ``slots x max_seq``.  LSTM ops keep their f32
+``(h, c)`` state pair of ``(slots, hidden)`` — cell state is positional
+carry, not a pageable sequence.
+
+That HBM is resident for the life of the engine — exactly the kind of
+allocation a static HBM gate must know about, so :func:`kv_page_plan`
+(and its scalar :func:`kv_cache_bytes`) is consumed by
 
 * the :class:`~flexflow_tpu.serving.generation.GenerationEngine`
-  (which also derives its actual cache placement from
+  (which also derives its actual pool placement from
   :func:`kv_cache_layout` — the runtime allocates what this module
-  predicts, byte for byte);
+  predicts, byte for byte, ``tests/test_generation.py`` pins it);
 * ``flexflow-tpu lint --serve-slots N --serve-seq S`` — the FF108 HBM
   gate and the FF121 liveness timeline both add the same scalar, so
   lint and the engine cannot disagree about whether a generation
   deployment fits;
-* ``flexflow-tpu explain`` — the memory report grows a ``kv_cache``
-  section with the same numbers.
+* ``flexflow-tpu explain`` — the memory report's ``kv_cache`` section
+  carries the same plan (pages, page_bytes, pool bytes);
+* the fleet co-residency gate (FF130/FF131,
+  ``serving/fleet/gate.py``) — generation tenants charge the pool.
+
+The default pool is sized to the dense worst case
+(``slots x ceil(max_seq / page_size)`` pages), so with ``page_size``
+dividing ``max_seq`` the GLOBAL accounting equals the pre-paging dense
+number, while the engine's *in-use* high-water mark (what the bench
+reports) drops with sharing.  One sharding caveat: the old dense cache
+slot-sharded over ``n`` where it divided; the pool's page dim is
+replicated (any slot must be able to borrow any page), so on a mesh
+where slot-sharding used to engage the PER-DEVICE KV bytes grow by
+that factor — re-run lint for n-sharded deployments, the old plan does
+NOT carry over there.
 
 Device-free: meshes are plain ``{axis: size}`` dicts (the
 :class:`~flexflow_tpu.parallel.mesh.AbstractMesh` view), so a 64-chip
@@ -27,13 +48,18 @@ serving deployment is sized from a laptop.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 from ..op import Op, OpType
 
 # the LSTM decode carry stays f32 across timesteps (ops/rnn.py keeps
 # cell state in f32 for stability) regardless of the compute dtype
 STATE_DTYPE_BYTES = 4
+
+# tokens per KV page (FFConfig.serve_kv_page's default).  16 keeps page
+# internal fragmentation under one short prompt while staying a
+# lane-friendly minor-dim multiple for the gathered attention view.
+DEFAULT_PAGE_SIZE = 16
 
 
 def _axis(mesh_sizes: Optional[Dict[str, int]], axis: str) -> int:
@@ -46,21 +72,59 @@ def slot_shard_degree(slots: int, mesh_sizes: Optional[Dict[str, int]]
     axis ``n`` — mirrors ``FFModel._infer_batch_entries``'s rule: never
     below 2 slots per shard (a 1-row shard lowers to matrix-vector
     kernels and breaks the decode==forward parity contract), replicate
-    when the axis does not divide."""
+    when the axis does not divide.  Applies to the LSTM state pair (and
+    the decode activations); the attention page POOL never slot-shards
+    — pages are interchangeable across slots."""
     n = _axis(mesh_sizes, "n")
     if n > 1 and slots % n == 0 and slots >= 2 * n:
         return n
     return 1
 
 
+def _check_page_args(page_size: int, num_pages: int = 0) -> None:
+    """Reject negative page knobs LOUDLY: ``int(x) or default`` keeps
+    a negative value, and a negative geometry flowing into the byte
+    math yields a negative KV charge — a gate that lint would PASS on
+    while the engine (GraphDecoder validates the same knobs) refuses
+    to build.  0 stays the default/auto sentinel everywhere."""
+    if page_size < 0 or num_pages < 0:
+        raise ValueError(
+            f"page_size/num_pages must be >= 0 (0 = default/auto), "
+            f"got {page_size}/{num_pages}")
+
+
+def pages_per_slot(max_seq: int, page_size: int = DEFAULT_PAGE_SIZE
+                   ) -> int:
+    """Page-table width: pages needed to hold one ``max_seq`` stream."""
+    _check_page_args(page_size)
+    page_size = int(page_size) or DEFAULT_PAGE_SIZE
+    return -(-int(max_seq) // page_size)  # ceil
+
+
+def default_num_pages(slots: int, max_seq: int,
+                      page_size: int = DEFAULT_PAGE_SIZE) -> int:
+    """The auto pool size (``serve_kv_pages=0``): the dense worst case
+    — every slot holding a full private ``max_seq`` stream.  Sharing
+    and mixed lengths keep the in-use high-water BELOW this; an
+    operator shrinks the pool once the bench shows the real mark."""
+    return int(slots) * pages_per_slot(max_seq, page_size)
+
+
 def kv_cache_layout(layers: List[Op],
                     mesh_sizes: Optional[Dict[str, int]],
-                    slots: int, max_seq: int) -> Dict[str, Dict]:
-    """Per-op decode-cache geometry: ``{op_name: {"kind": "kv"|"state",
-    "shapes": {leaf: shape}, "entries": {leaf: PartitionSpec entries},
-    "dtype": "compute"|"f32"}}``.  THE one place the cache layout is
-    decided — the generation decoder allocates exactly this, and
-    :func:`kv_cache_bytes` integrates exactly this."""
+                    slots: int, max_seq: int,
+                    page_size: int = DEFAULT_PAGE_SIZE,
+                    num_pages: int = 0) -> Dict[str, Dict]:
+    """Per-op decode-state geometry: ``{op_name: {"kind":
+    "kv"|"state", "shapes": {leaf: shape}, "entries": {leaf:
+    PartitionSpec entries}, "dtype": "compute"|"f32"}}``.  THE one
+    place the pool layout is decided — the generation decoder allocates
+    exactly this (through ``serving/generation/pages.py``, the only
+    module allowed to allocate it — repo_lint RL013), and
+    :func:`kv_page_plan` integrates exactly this."""
+    _check_page_args(page_size, num_pages)
+    page_size = int(page_size) or DEFAULT_PAGE_SIZE
+    pool = int(num_pages) or default_num_pages(slots, max_seq, page_size)
     n_deg = slot_shard_degree(slots, mesh_sizes)
     c = _axis(mesh_sizes, "c")
     out: Dict[str, Dict] = {}
@@ -68,9 +132,10 @@ def kv_cache_layout(layers: List[Op],
         if op.op_type == OpType.ATTENTION and hasattr(op, "num_heads"):
             h, hd = op.num_heads, op.head_dim
             c_entry = "c" if (c > 1 and h % c == 0) else None
-            n_entry = "n" if n_deg > 1 else None
-            shape = (int(slots), int(max_seq), h, hd)
-            entries = (n_entry, None, c_entry, None)
+            shape = (pool, page_size, h, hd)
+            # pages replicated over 'n' (interchangeable across slots),
+            # heads sharded over 'c' like the projections feeding them
+            entries = (None, None, c_entry, None)
             out[op.name] = {
                 "kind": "kv",
                 "shapes": {"k": shape, "v": shape},
@@ -92,20 +157,33 @@ def kv_cache_layout(layers: List[Op],
     return out
 
 
-def kv_cache_bytes(layers: List[Op],
-                   mesh_sizes: Optional[Dict[str, int]],
-                   slots: int, max_seq: int,
-                   kv_dtype_bytes: int = 2) -> float:
-    """Per-DEVICE bytes of the preallocated decode state for ``slots``
-    concurrent streams of up to ``max_seq`` positions: attention K+V
-    (``kv_dtype_bytes`` — the compute dtype the caches are held in,
-    2 for bf16, 4 for f32) sharded ``slots/n x heads/c``, plus the f32
-    LSTM (h, c) carries.  Integrates :func:`kv_cache_layout` — the
-    engine's real allocation and this number cannot drift apart."""
-    layout = kv_cache_layout(layers, mesh_sizes, slots, max_seq)
+def kv_page_plan(layers: List[Op],
+                 mesh_sizes: Optional[Dict[str, int]],
+                 slots: int, max_seq: int,
+                 kv_dtype_bytes: int = 2,
+                 page_size: int = DEFAULT_PAGE_SIZE,
+                 num_pages: int = 0) -> Dict:
+    """THE page-pool accounting: per-DEVICE bytes of the paged decode
+    state.  Returns ``{"page_size", "pages_per_slot", "num_pages",
+    "page_bytes", "pool_bytes", "state_bytes", "total_bytes"}`` where
+    ``page_bytes`` is the per-device cost of ONE page summed over every
+    attention op's K+V pools (``kv_dtype_bytes`` each — the compute
+    dtype, 2 for bf16, 4 for f32 — heads divided over ``c``),
+    ``pool_bytes = num_pages * page_bytes``, and ``state_bytes`` is the
+    f32 LSTM ``(h, c)`` carry (``slots/n x hidden/c``).  Integrates
+    :func:`kv_cache_layout` leaf-for-leaf, so the engine's real
+    allocation and these numbers cannot drift apart; the engine's
+    high-water mark is ``pages_high_water * page_bytes + state_bytes``
+    with the SAME ``page_bytes``."""
+    _check_page_args(page_size, num_pages)
+    page_size = int(page_size) or DEFAULT_PAGE_SIZE
+    pool = int(num_pages) or default_num_pages(slots, max_seq, page_size)
+    layout = kv_cache_layout(layers, mesh_sizes, slots, max_seq,
+                             page_size=page_size, num_pages=pool)
     n_deg = slot_shard_degree(slots, mesh_sizes)
     c = _axis(mesh_sizes, "c")
-    total = 0.0
+    page_bytes = 0.0
+    state_bytes = 0.0
     for entry in layout.values():
         bytes_per = (kv_dtype_bytes if entry["dtype"] == "compute"
                      else STATE_DTYPE_BYTES)
@@ -119,8 +197,40 @@ def kv_cache_bytes(layers: List[Op],
                     parts *= n_deg
                 elif e == "c":
                     parts *= c
-            total += vol * bytes_per / parts
-    return total
+            if entry["kind"] == "kv":
+                # per-page cost: the pool volume divided by its pages
+                page_bytes += vol * bytes_per / parts / pool
+            else:
+                state_bytes += vol * bytes_per / parts
+    return {
+        "page_size": page_size,
+        "pages_per_slot": pages_per_slot(max_seq, page_size),
+        "num_pages": pool,
+        "page_bytes": page_bytes,
+        "pool_bytes": page_bytes * pool,
+        "state_bytes": state_bytes,
+        "total_bytes": page_bytes * pool + state_bytes,
+    }
+
+
+def kv_cache_bytes(layers: List[Op],
+                   mesh_sizes: Optional[Dict[str, int]],
+                   slots: int, max_seq: int,
+                   kv_dtype_bytes: int = 2,
+                   page_size: int = DEFAULT_PAGE_SIZE,
+                   num_pages: int = 0) -> float:
+    """Per-DEVICE bytes of the preallocated paged decode state — the
+    scalar the FF108/FF121/FF130 gates charge (the ``total_bytes`` of
+    :func:`kv_page_plan`).  With the default pool size and a
+    ``page_size`` dividing ``max_seq`` this equals the pre-paging dense
+    number on meshes where the dense cache did not slot-shard; where it
+    did (``n`` dividing ``slots``), the replicated page dim makes the
+    per-device charge larger by that degree — see the module
+    docstring's sharding caveat."""
+    return kv_page_plan(layers, mesh_sizes, slots, max_seq,
+                        kv_dtype_bytes=kv_dtype_bytes,
+                        page_size=page_size,
+                        num_pages=num_pages)["total_bytes"]
 
 
 def default_serve_seq(input_tensors) -> Optional[int]:
@@ -138,7 +248,7 @@ def default_serve_seq(input_tensors) -> Optional[int]:
 def dtype_bytes(dtype_name: str) -> int:
     """Byte width of a compute dtype name ('bfloat16' -> 2,
     'float32' -> 4) — shared by the engine and the CLI so both feed
-    :func:`kv_cache_bytes` the same ``kv_dtype_bytes``."""
+    :func:`kv_page_plan` the same ``kv_dtype_bytes``."""
     import numpy as np
     try:
         return int(np.dtype(dtype_name).itemsize)
@@ -147,5 +257,7 @@ def dtype_bytes(dtype_name: str) -> int:
         return 2 if "bfloat16" in str(dtype_name) else 4
 
 
-__all__ = ["kv_cache_layout", "kv_cache_bytes", "slot_shard_degree",
-           "dtype_bytes", "default_serve_seq", "STATE_DTYPE_BYTES"]
+__all__ = ["kv_cache_layout", "kv_cache_bytes", "kv_page_plan",
+           "slot_shard_degree", "pages_per_slot", "default_num_pages",
+           "dtype_bytes", "default_serve_seq", "STATE_DTYPE_BYTES",
+           "DEFAULT_PAGE_SIZE"]

@@ -301,7 +301,9 @@ def explain_report(model_name: str, layers: List[Op],
                    opt_slot_bytes: int = 4,
                    sparse_tables=frozenset(),
                    serve_slots: int = 0,
-                   serve_seq: int = 0) -> Dict:
+                   serve_seq: int = 0,
+                   serve_kv_page: int = 0,
+                   serve_kv_pages: int = 0) -> Dict:
     """The full device-free ``flexflow-tpu explain`` payload: propagated
     sharding summary, predicted FF120 fallbacks, the communication plan
     (+ digest), and the liveness HBM timeline.  ``mesh_shape`` defaults
@@ -348,11 +350,19 @@ def explain_report(model_name: str, layers: List[Op],
     kv_bytes = 0.0
     kv_section = None
     if serve_slots > 0 and serve_seq > 0:
-        from .kv_memory import kv_cache_bytes
-        kv_bytes = kv_cache_bytes(layers, mesh_shape, serve_slots,
-                                  serve_seq, kv_dtype_bytes=dtype_bytes)
+        from .kv_memory import kv_page_plan
+        kv_plan = kv_page_plan(layers, mesh_shape, serve_slots,
+                               serve_seq, kv_dtype_bytes=dtype_bytes,
+                               page_size=serve_kv_page,
+                               num_pages=serve_kv_pages)
+        kv_bytes = kv_plan["total_bytes"]
         kv_section = {"slots": int(serve_slots),
                       "max_seq": int(serve_seq),
+                      "page_size": kv_plan["page_size"],
+                      "num_pages": kv_plan["num_pages"],
+                      "page_bytes": kv_plan["page_bytes"],
+                      "pool_bytes": kv_plan["pool_bytes"],
+                      "state_bytes": kv_plan["state_bytes"],
                       "bytes_per_device": kv_bytes}
     timeline = sim.memory_timeline(layers, strategies, mesh_shape,
                                    assume_remat=False,
@@ -435,7 +445,8 @@ def render_explain_text(rep: Dict, top: int = 8) -> str:
             f"  KV cache: {kv['slots']} decode slot(s) x "
             f"{kv['max_seq']} positions = "
             f"{kv['bytes_per_device'] / 1e6:.2f} MB/device "
-            f"(resident in the timeline below)")
+            f"({kv['num_pages']} pages of {kv['page_size']} tokens; "
+            f"resident in the timeline below)")
     lines.append(
         f"  HBM timeline: state {m['state_bytes'] / 1e9:.3f} GB, "
         f"high-water {m['peak_bytes'] / 1e9:.3f} GB at "

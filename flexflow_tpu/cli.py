@@ -217,6 +217,14 @@ def lint_main(argv) -> int:
     parser.add_argument("--serve-seq", type=int, default=0,
                         help="generation cache length per slot "
                              "(default: the model's sequence length)")
+    parser.add_argument("--serve-kv-page", type=int, default=0,
+                        help="KV page size of the deployment being "
+                             "sized (default: the engine default) — "
+                             "pass the same value the engine runs "
+                             "with, or lint charges a different pool")
+    parser.add_argument("--serve-kv-pages", type=int, default=0,
+                        help="KV pool pages (0 = auto, the dense "
+                             "worst case slots x ceil(seq/page))")
     args = parser.parse_args(argv)
 
     if args.fleet:
@@ -276,6 +284,10 @@ def lint_main(argv) -> int:
                                    hbm_capacity=args.hbm_gb * 1e9)
 
     kv_bytes = 0.0
+    if args.serve_kv_page < 0 or args.serve_kv_pages < 0:
+        print("lint: --serve-kv-page/--serve-kv-pages must be >= 0 "
+              "(0 = default/auto)", file=sys.stderr)
+        return 2
     if args.serve_slots > 0:
         # the generation engine's preallocated KV cache — the SAME
         # scalar the runtime reports (analysis.kv_memory), so the FF108
@@ -295,7 +307,9 @@ def lint_main(argv) -> int:
                 strategies or {}, model.layers, args.devices or 10 ** 9)
         kv_bytes = kv_cache_bytes(
             model.layers, shape_for_kv, args.serve_slots, seq,
-            kv_dtype_bytes=dtype_bytes(cfg.compute_dtype))
+            kv_dtype_bytes=dtype_bytes(cfg.compute_dtype),
+            page_size=args.serve_kv_page,
+            num_pages=args.serve_kv_pages)
 
     from .analysis import verify
     report = verify(
@@ -415,6 +429,12 @@ def explain_main(argv) -> int:
     parser.add_argument("--serve-seq", type=int, default=0,
                         help="generation cache length per slot "
                              "(default: the model's sequence length)")
+    parser.add_argument("--serve-kv-page", type=int, default=0,
+                        help="KV page size of the deployment being "
+                             "explained (default: the engine default)")
+    parser.add_argument("--serve-kv-pages", type=int, default=0,
+                        help="KV pool pages (0 = auto, the dense "
+                             "worst case)")
     args = parser.parse_args(argv)
 
     if args.fleet:
@@ -462,6 +482,10 @@ def explain_main(argv) -> int:
         spec = dataclasses.replace(spec_for_device(),
                                    hbm_capacity=args.hbm_gb * 1e9)
 
+    if args.serve_kv_page < 0 or args.serve_kv_pages < 0:
+        print("explain: --serve-kv-page/--serve-kv-pages must be >= 0 "
+              "(0 = default/auto)", file=sys.stderr)
+        return 2
     serve_seq = args.serve_seq
     if args.serve_slots > 0 and serve_seq <= 0:
         from .analysis.kv_memory import default_serve_seq
@@ -476,7 +500,9 @@ def explain_main(argv) -> int:
     rep = explain_report(
         args.model, model.layers, strategies, mesh_shape=mesh_shape,
         num_devices=args.devices or None, spec=spec,
-        serve_slots=args.serve_slots, serve_seq=serve_seq)
+        serve_slots=args.serve_slots, serve_seq=serve_seq,
+        serve_kv_page=args.serve_kv_page,
+        serve_kv_pages=args.serve_kv_pages)
     if args.json:
         import json as _json
         text = _json.dumps(rep, indent=2)

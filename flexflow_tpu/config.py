@@ -343,6 +343,29 @@ class FFConfig:
     # serve_gen_max_new_tokens: default generation budget per request
     # when submit() does not specify one.
     serve_gen_max_new_tokens: int = 32
+    # Paged KV cache (docs/serving.md "Paged KV & prefix caching").
+    # serve_kv_page: tokens per KV page — the sharing/allocation
+    # granularity of the generation engine's page pool (and the prefix
+    # cache's match granularity: only full pages are shareable).
+    serve_kv_page: int = 16
+    # serve_kv_pages: total pool pages; 0 = auto, the dense worst case
+    # slots x ceil(max_seq / page) so the accounting equals the old
+    # dense preallocation (analysis/kv_memory.py) — shrink it once the
+    # bench's high-water evidence says so.  Undersized pools shed
+    # streams (KVCacheExhausted) after LRU-evicting cached prefixes.
+    serve_kv_pages: int = 0
+    # serve_prefix_cache: "on" (default) caches full pages of prompt
+    # prefixes in a ref-counted trie so shared system prompts skip
+    # their prefill; "off" disables it — tokens are bit-identical
+    # either way (the ISSUE 15 correctness anchor), only TTFT and
+    # pages-in-use change.
+    serve_prefix_cache: str = "on"
+    # serve_prefill_chunk: prefill long prompts in chunks of this many
+    # tokens, at most one chunk per decode-step boundary, capping the
+    # decode stall a joining prompt inflicts on in-flight streams
+    # (Sarathi-style).  0 = whole-prompt chunks (the monolithic
+    # baseline serve-bench --generate compares against).
+    serve_prefill_chunk: int = 0
     # Sparse embedding-table updates (reference parity: the embedding
     # backward scatter-accumulates only the touched rows,
     # embedding.cu:192-228 — it never streams the full table).  A dense
@@ -371,6 +394,19 @@ class FFConfig:
             raise ValueError(
                 f"FFConfig.serve_quantize must be '' or 'int8', got "
                 f"{self.serve_quantize!r}")
+        if self.serve_prefix_cache not in ("on", "off"):
+            raise ValueError(
+                f"FFConfig.serve_prefix_cache must be 'on' or 'off', "
+                f"got {self.serve_prefix_cache!r}")
+        if self.serve_kv_page < 1:
+            raise ValueError(
+                f"FFConfig.serve_kv_page must be >= 1, got "
+                f"{self.serve_kv_page}")
+        if self.serve_kv_pages < 0 or self.serve_prefill_chunk < 0:
+            raise ValueError(
+                f"FFConfig.serve_kv_pages/serve_prefill_chunk must be "
+                f">= 0 (0 = auto/monolithic), got "
+                f"{self.serve_kv_pages}/{self.serve_prefill_chunk}")
 
     @property
     def num_devices(self) -> int:
@@ -499,6 +535,18 @@ class FFConfig:
                 cfg.serve_gen_max_seq = int(val())
             elif a == "--serve-gen-max-new":
                 cfg.serve_gen_max_new_tokens = int(val())
+            elif a == "--serve-kv-page":
+                cfg.serve_kv_page = int(val())
+            elif a == "--serve-kv-pages":
+                cfg.serve_kv_pages = int(val())
+            elif a == "--serve-prefix-cache":
+                cfg.serve_prefix_cache = val().lower()
+                if cfg.serve_prefix_cache not in ("on", "off"):
+                    raise ValueError(
+                        f"--serve-prefix-cache must be 'on' or 'off', "
+                        f"got {cfg.serve_prefix_cache!r}")
+            elif a == "--serve-prefill-chunk":
+                cfg.serve_prefill_chunk = int(val())
             elif a == "--trace-sample-rate":
                 cfg.trace_sample_rate = float(val())
             elif a == "--metrics-port":

@@ -126,6 +126,30 @@ def _decode_attention(q, k_cache, v_cache, pos, scale: float):
     return out[:, :1]
 
 
+def _paged_chunk_attention(q, kg, vg, qpos, scale: float):
+    """Chunked-prefill attention against the gathered page view (the
+    paged prefill kernel — docs/serving.md "Paged KV & prefix
+    caching").  ``q``: (1, B, h, d) — the chunk's queries at GLOBAL
+    positions ``qpos`` (B,); ``kg``/``vg``: (1, L, h, d) — the slot's
+    page table gathered back into position order (history pages + the
+    chunk's own rows, which the caller scattered in before gathering).
+    Mirrors :func:`_dense_attention`'s causal arithmetic exactly — f32
+    scores, the same finite ``NEG_INF`` mask whose exp underflows to an
+    exact 0.0 — with the mask keyed on global positions, so a chunk's
+    row t reproduces the monolithic forward's row t bit-identically on
+    CPU (tests/test_generation.py pins it per chunk size).  Columns
+    beyond a row's position (unwritten pool rows, stale page contents)
+    contribute exact zeros, never values."""
+    scores = jnp.einsum("nqhd,nkhd->nhqk", q, kg,
+                        preferred_element_type=jnp.float32) * scale
+    kpos = jnp.arange(kg.shape[1])
+    scores = jnp.where(kpos[None, None, None, :]
+                       > qpos[None, None, :, None], NEG_INF, scores)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("nhqk,nkhd->nqhd", probs.astype(vg.dtype), vg,
+                      preferred_element_type=jnp.float32)
+
+
 def _dense_attention(q, k, v, causal: bool, scale: float,
                      dropout_rate: float, rng):
     """(n,sq,h,d),(n,sk,h,d),(n,sk,h,d) -> (n,sq,h,d); f32 softmax."""
@@ -355,6 +379,87 @@ class MultiHeadAttention(Op):
             attn = _dense_attention(q, k, v, self.causal, scale, 0.0, None)
         return [self._out_proj(params, attn, n, sq, ctx)], k, v
 
+    # ---- paged KV cache (docs/serving.md "Paged KV & prefix caching") --
+    def forward_paged(self, params, x, k_pool, v_pool, table_row, start,
+                      length, ctx: OpContext):
+        """One prefill CHUNK against the paged KV cache: project the
+        chunk's Q/K/V, scatter its K/V rows into the slot's pages (the
+        page table as scatter indices), then attend each chunk query
+        over the whole gathered table — history pages written by
+        earlier chunks (or borrowed from the prefix cache) plus the
+        chunk itself, causally masked on GLOBAL positions.
+
+        ``x``: (1, B, d) chunk hidden states at positions ``start ..
+        start+B-1``; ``k_pool``/``v_pool``: (num_pages, page, h, hd)
+        pools; ``table_row``: (pages_per_slot,) int32 page ids (the
+        pool's ``no_page`` sentinel marks unallocated entries — reads
+        of them are masked, writes to them dropped); ``length``: valid
+        rows in the chunk (pad rows' writes are dropped via the OOB
+        sentinel and their outputs are garbage the caller ignores).
+        Functional like :meth:`decode` — the jitted chunk program
+        donates the pools.  Shares :meth:`_qkv`/:meth:`_out_proj` with
+        forward, so chunked prefill == the monolithic forward row for
+        row (the ISSUE 15 parity anchor)."""
+        assert self._self_attn and self.causal, \
+            f"{self.name}: paged prefill needs causal self-attention"
+        xq = cast_compute(x, ctx)
+        n, B, _ = xq.shape
+        q, k, v = self._qkv(params, xq, xq, xq, ctx)
+        page = k_pool.shape[1]
+        no_page = k_pool.shape[0]
+        qpos = start + jnp.arange(B)
+        # mode="clip" everywhere: the sentinel id is OOB by design, and
+        # jnp.take's default "fill" mode would gather NaN — which the
+        # exact-zero mask multiplies to NaN, not zero
+        wp = jnp.take(table_row, qpos // page, mode="clip")
+        wp = jnp.where(jnp.arange(B) < length, wp, no_page)
+        wr = qpos % page
+        k_pool = k_pool.at[wp, wr].set(k[0], mode="drop")
+        v_pool = v_pool.at[wp, wr].set(v[0], mode="drop")
+        h, hd = self.num_heads, self.head_dim
+        kg = jnp.take(k_pool, table_row, axis=0,
+                      mode="clip").reshape(1, -1, h, hd)
+        vg = jnp.take(v_pool, table_row, axis=0,
+                      mode="clip").reshape(1, -1, h, hd)
+        attn = _paged_chunk_attention(q, kg, vg, qpos,
+                                      1.0 / math.sqrt(self.head_dim))
+        return ([self._out_proj(params, attn, n, B, ctx)],
+                k_pool, v_pool)
+
+    def decode_paged(self, params, x, k_pool, v_pool, table, pos,
+                     write_pages, write_rows, ctx: OpContext):
+        """One decode step against the paged KV cache: project the
+        current token per slot, scatter its K/V into
+        ``(write_pages[i], write_rows[i])`` (the engine computes these
+        host-side — ``no_page`` for inactive/prefilling slots, whose
+        writes must drop rather than corrupt a shared page), gather
+        each slot's page table back into position order and attend.
+
+        ``x``: (slots, 1, d); ``table``: (slots, pages_per_slot) int32;
+        ``pos``: (slots,) int32 current position.  The gathered view is
+        ``pages_per_slot * page`` wide; positions beyond ``pos`` are
+        masked to exact zeros, so the step is bit-identical on CPU to
+        the dense full-sequence forward's row at ``pos`` (the same
+        :func:`_decode_attention` kernel, fed a gathered cache)."""
+        n = x.shape[0]
+        xq = cast_compute(x, ctx)
+        q, k, v = self._qkv(params, xq, xq, xq, ctx)
+        k_pool = k_pool.at[write_pages, write_rows].set(k[:, 0],
+                                                       mode="drop")
+        v_pool = v_pool.at[write_pages, write_rows].set(v[:, 0],
+                                                       mode="drop")
+        h, hd = self.num_heads, self.head_dim
+        # mode="clip": sentinel table entries are OOB by design (the
+        # default "fill" would gather NaN that poisons the masked sum)
+        kg = jnp.take(k_pool, table, axis=0,
+                      mode="clip").reshape(n, -1, h, hd)
+        vg = jnp.take(v_pool, table, axis=0,
+                      mode="clip").reshape(n, -1, h, hd)
+        attn = _decode_attention(q, kg, vg, pos,
+                                 1.0 / math.sqrt(self.head_dim))
+        return ([self._out_proj(params, attn, n, 1, ctx)],
+                k_pool, v_pool)
+
     def decode(self, params, x, k_cache, v_cache, pos, ctx: OpContext):
         """One decode step: project the current token, write its K/V
         into the per-slot cache at ``pos``, attend over the cache.
@@ -461,6 +566,17 @@ class PositionEmbedding(Op):
         that position."""
         rows = jnp.take(params[self.w_table.name], pos, axis=0)
         return [x + cast_compute(rows, ctx)[:, None, :]]
+
+    def forward_at(self, params, x, start, ctx: OpContext):
+        """Offset lookup for chunked prefill: ``x`` (1, B, d) holds
+        GLOBAL positions ``start .. start+B-1`` — gathers those table
+        rows (pad rows past the table clip; their outputs are chunk
+        padding the caller ignores).  Row for row the same values
+        ``forward``'s leading-slice broadcast adds, so a chunk at
+        offset 0 covering the whole prompt IS the forward."""
+        pos = start + jnp.arange(x.shape[1])
+        rows = jnp.take(params[self.w_table.name], pos, axis=0)
+        return [x + cast_compute(rows, ctx)[None]]
 
     def parallel_dims(self):
         return (True, True, False)

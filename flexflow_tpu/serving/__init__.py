@@ -7,15 +7,17 @@ serving metrics and the ``flexflow-tpu serve-bench`` harness."""
 from .batcher import (ADMISSION_POLICIES, MicroBatcher, Request, bucket_for,
                       derive_buckets, split_sizes)
 from .engine import HEALTH_STATES, ServingEngine
-from .errors import (DeadlineExceeded, GenerationCancelled, OverloadError,
-                     ServingError, SheddedError)
+from .errors import (DeadlineExceeded, GenerationCancelled,
+                     KVCacheExhausted, OverloadError, ServingError,
+                     SheddedError)
 from .fleet import FleetEngine, ModelRegistry, TenantSpec
 from .generation import GenerationEngine, GenerationStream
 from .metrics import ServingMetrics
 
 __all__ = ["ServingEngine", "MicroBatcher", "Request", "ServingMetrics",
            "ServingError", "OverloadError", "SheddedError",
-           "DeadlineExceeded", "GenerationCancelled", "GenerationEngine",
+           "DeadlineExceeded", "GenerationCancelled", "KVCacheExhausted",
+           "GenerationEngine",
            "GenerationStream", "FleetEngine", "ModelRegistry",
            "TenantSpec", "ADMISSION_POLICIES", "HEALTH_STATES",
            "bucket_for", "derive_buckets", "split_sizes"]

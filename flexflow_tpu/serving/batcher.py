@@ -527,8 +527,17 @@ class MicroBatcher:
         generation engine's decode loop can run with every slot busy
         for seconds while queued prompts' deadlines lapse, and poll()
         (which would also TAKE work) only runs when a slot frees.
-        Returns the number of requests expired."""
+        Returns the number of requests expired.
+
+        The no-deadline case is O(1): ``_watch`` is the live count of
+        deadline/stale-bearing requests, and when it is zero this
+        returns without reading the clock or entering the scan at all
+        — this runs at EVERY decode-step boundary, and the common
+        workload queues nothing reapable (pinned in
+        tests/test_serving.py: the scan path is never entered)."""
         with self._cv:
+            if not self._watch:
+                return 0
             fire = self._collect_expired(self.clock())
         self._fire_expired(fire)
         return len(fire)

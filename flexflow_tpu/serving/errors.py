@@ -41,6 +41,16 @@ class DeadlineExceeded(ServingError):
     request's future."""
 
 
+class KVCacheExhausted(SheddedError):
+    """The paged KV pool ran out of pages for a stream — even after
+    LRU-evicting every unreferenced prefix-cache page — so the stream
+    was shed to protect the others (docs/serving.md "Paged KV & prefix
+    caching").  A ``SheddedError`` subclass: pool exhaustion is a
+    load-shedding decision, counted and traced as ``shed``.  Only
+    reachable when ``serve_kv_pages`` undersizes the pool below the
+    dense worst case (the auto default cannot exhaust)."""
+
+
 class GenerationCancelled(ServingError):
     """A token-generation stream was cancelled — by its client
     (``GenerationStream.cancel()``) or the ``serve_cancel_at_token``

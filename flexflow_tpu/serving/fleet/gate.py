@@ -28,8 +28,8 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Tuple
 
 from ...analysis.diagnostics import DiagnosticReport, make
-from ...analysis.kv_memory import (default_serve_seq, dtype_bytes,
-                                   kv_cache_bytes)
+from ...analysis.kv_memory import (DEFAULT_PAGE_SIZE, default_serve_seq,
+                                   dtype_bytes, kv_cache_bytes)
 from ...analysis.strategy_passes import infer_mesh_shape
 from ...parallel.mesh import AbstractMesh
 from .registry import ModelRegistry, TenantSpec
@@ -80,10 +80,15 @@ def model_residency(spec: TenantSpec, layers, input_tensors, strategies,
                     mesh_shape: Optional[Dict[str, int]] = None,
                     device_spec=None,
                     xla_temp_factor: Optional[float] = None,
-                    compute_dtype: str = "float32") -> Dict:
+                    compute_dtype: str = "float32",
+                    model_config=None) -> Dict:
     """One tenant's per-device memory prediction (see module
     docstring).  ``mesh_shape`` defaults to the strategy-inferred mesh
-    (exactly like ``lint``)."""
+    (exactly like ``lint``).  ``model_config`` (the built tenant's
+    FFConfig) supplies the SAME fallbacks the GenerationEngine resolves
+    — page geometry (``serve_kv_page``/``serve_kv_pages``) and the
+    compute dtype — so a knob set in the builder's config rather than
+    the fleet spec still reaches the gate's accounting."""
     from ...search.cost_model import XLA_TEMP_FACTOR, spec_for_device
     from ...search.simulator import Simulator
 
@@ -100,13 +105,27 @@ def model_residency(spec: TenantSpec, layers, input_tensors, strategies,
     mesh = AbstractMesh(mesh_shape)
     kv = 0.0
     slots = seq = 0
+    kv_pages = kv_page = 0
+    if model_config is not None:
+        compute_dtype = getattr(model_config, "compute_dtype",
+                                compute_dtype)
     if spec.engine == "generation":
         slots = int(spec.generation.get("slots", 8))
         seq = (int(spec.generation.get("max_seq", 0))
                or default_serve_seq(input_tensors) or 0)
+        # the tenant's paged-KV geometry: the SAME resolution chain
+        # the GenerationEngine runs — spec key, else the builder's
+        # FFConfig, else the kv_memory defaults — so gate and runtime
+        # integrate one pool no matter where the knob was set
+        kv_page = (int(spec.generation.get("page_size", 0))
+                   or int(getattr(model_config, "serve_kv_page", 0)))
+        kv_pages = (int(spec.generation.get("num_pages", 0))
+                    or int(getattr(model_config, "serve_kv_pages", 0)))
         if slots > 0 and seq > 0:
             kv = kv_cache_bytes(layers, mesh_shape, slots, seq,
-                                kv_dtype_bytes=dtype_bytes(compute_dtype))
+                                kv_dtype_bytes=dtype_bytes(compute_dtype),
+                                page_size=kv_page or DEFAULT_PAGE_SIZE,
+                                num_pages=kv_pages)
     sim = Simulator(spec=device_spec,
                     num_devices=max(1, mesh.mesh_product),
                     use_native=False, opt_slot_bytes=0)
@@ -178,7 +197,8 @@ def fleet_gate_report(registry: ModelRegistry,
         model, strategies = registry.graph(name)
         row = model_residency(spec, model.layers, model.input_tensors,
                               strategies, device_spec=device_spec,
-                              xla_temp_factor=xla_temp_factor)
+                              xla_temp_factor=xla_temp_factor,
+                              model_config=model.config)
         rows.append(row)
         total += row["ff108_bytes"]
         kv_note = (f" + {row['kv_bytes'] / 1e9:.2f} GB KV "

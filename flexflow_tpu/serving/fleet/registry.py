@@ -49,7 +49,11 @@ _SERVE_KEYS = frozenset((
     "starvation_ms", "stats_every"))
 _GEN_KEYS = frozenset((
     "slots", "max_seq", "max_new_tokens", "eos_id", "max_queue_requests",
-    "admission", "starvation_ms", "stats_every"))
+    "admission", "starvation_ms", "stats_every",
+    # paged KV knobs (ISSUE 15): the co-residency gate reads the SAME
+    # keys (serving/fleet/gate.py), so a tenant's page geometry and its
+    # FF130 accounting cannot diverge
+    "page_size", "num_pages", "prefill_chunk", "prefix_cache"))
 
 
 @dataclasses.dataclass
@@ -185,6 +189,13 @@ def validate_fleet_json(obj) -> List[str]:
             if unknown:
                 probs.append(f"{where}: unknown {section} key(s) "
                              f"{unknown} (have {sorted(allowed)})")
+            # paged-KV geometry keys: a negative value would flow into
+            # the gate's kv_memory math as a NEGATIVE HBM charge
+            for key in ("page_size", "num_pages", "prefill_chunk"):
+                if key in sec and not (isinstance(sec[key], int)
+                                       and sec[key] >= 0):
+                    probs.append(f"{where}: {section}.{key} must be an "
+                                 f"int >= 0 (0 = default/auto)")
         if kind == "generation" and e.get("serve"):
             probs.append(f"{where}: generation tenants take a "
                          f"'generation' section, not 'serve'")

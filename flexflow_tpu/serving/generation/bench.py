@@ -123,13 +123,18 @@ def run_static(model, trace, slots: int, max_seq: int,
                stamp: Dict) -> Tuple[Dict, List[List[int]]]:
     """Phase 2: run-to-completion batching over the SAME compiled
     programs — groups of ``slots`` requests decode until the group's
-    longest budget is exhausted; early finishers idle in their slots."""
+    longest budget is exhausted; early finishers idle in their slots.
+    Drives the paged decoder directly with a static dense-equivalent
+    page assignment (slot i owns pages i*tpp .. (i+1)*tpp-1)."""
     import jax
 
     from .decoder import GraphDecoder
 
     dec = GraphDecoder.for_model(model, slots, max_seq)
     caches = dec.init_cache()
+    tpp, page = dec.pages_per_slot, dec.page_size
+    assert dec.num_pages >= slots * tpp, "auto pool covers the dense case"
+    table = np.arange(slots * tpp, dtype=np.int32).reshape(slots, tpp)
     outs: List[List[int]] = []
     useful = sum(mn for _, mn in trace)
     steps = 0
@@ -144,8 +149,8 @@ def run_static(model, trace, slots: int, max_seq: int,
             tok = np.zeros((1, bucket), np.int32)
             tok[0, :prompt.size] = prompt
             first, caches = dec.prefill_fn(bucket)(
-                model._params, caches, tok, np.int32(i),
-                np.int32(prompt.size))
+                model._params, caches, tok, table[i], np.int32(i),
+                np.int32(0), np.int32(prompt.size))
             states.append({
                 "last": int(jax.device_get(first)),
                 "len": int(prompt.size), "gen": 1, "max": max_new,
@@ -155,11 +160,16 @@ def run_static(model, trace, slots: int, max_seq: int,
         while any(st["gen"] < st["max"] for st in states):
             toks = np.zeros((slots,), np.int32)
             pos = np.zeros((slots,), np.int32)
+            wp = np.full((slots,), dec.num_pages, np.int32)
+            wr = np.zeros((slots,), np.int32)
             for i, st in enumerate(states):
                 toks[i] = st["last"]
-                pos[i] = min(st["len"], max_seq - 1)
+                p = min(st["len"], max_seq - 1)
+                pos[i] = p
+                wp[i] = table[i, p // page]
+                wr[i] = p % page
             nxt, caches = dec.decode_fn()(model._params, caches, toks,
-                                          pos)
+                                          pos, table, wp, wr)
             host = np.asarray(jax.device_get(nxt))
             steps += 1
             for i, st in enumerate(states):
@@ -251,6 +261,278 @@ def run_slo_cell(model, trace, slots: int, max_seq: int, rate: float,
         "peak_queue_requests": snap["peak_queue_requests"],
         **stamp,
     }
+
+
+# ---------------------------------------------------------------------
+# shared-prefix + chunked-prefill bench (ISSUE 15): the artifact behind
+# artifacts/gen_prefix_bench_r16.json — TTFT with the prefix cache on
+# vs off on a shared-prompt trace, decode-stall with chunked vs
+# monolithic prefill, and the paged pool's HBM high-water vs the dense
+# baseline, all with bit-identical token parity across arms.
+# ---------------------------------------------------------------------
+def make_prefix_trace(n: int, prefix_len: int, suffix_lo: int,
+                      suffix_hi: int, short_new: int, long_new: int,
+                      long_frac: float, seed: int,
+                      n_prefixes: int = 2) -> List[Tuple[np.ndarray, int]]:
+    """Shared-prompt + mixed-length trace: every request is one of
+    ``n_prefixes`` shared system prompts (``prefix_len`` tokens — the
+    few-shot/system-prompt regime) plus a short unique suffix, with the
+    bimodal output budget of :func:`make_gen_trace`."""
+    rng = np.random.default_rng(seed)
+    prefixes = [rng.integers(1, VOCAB, prefix_len).astype(np.int32)
+                for _ in range(n_prefixes)]
+    out = []
+    for _ in range(n):
+        pref = prefixes[int(rng.integers(0, n_prefixes))]
+        slen = int(rng.integers(suffix_lo, suffix_hi + 1))
+        suffix = rng.integers(1, VOCAB, slen).astype(np.int32)
+        prompt = np.concatenate([pref, suffix])
+        max_new = long_new if rng.random() < long_frac else short_new
+        out.append((prompt, int(max_new)))
+    return out
+
+
+def _run_prefix_arm(model, trace, slots: int, max_seq: int,
+                    prefix_cache: str, stamp: Dict
+                    ) -> Tuple[Dict, List[List[int]]]:
+    """One prefix-cache A/B arm: the engine at max rate with the cache
+    on or off — same compiled programs, same trace, same admission."""
+    from .engine import GenerationEngine
+
+    eng = GenerationEngine(model, slots=slots, max_seq=max_seq,
+                           stats_every=0, prefix_cache=prefix_cache)
+    useful = sum(mn for _, mn in trace)
+    with eng:
+        t0 = time.perf_counter()
+        streams = [eng.submit(p, max_new_tokens=mn) for p, mn in trace]
+        outs = [list(int(t) for t in s.result(timeout=600))
+                for s in streams]
+        dt = time.perf_counter() - t0
+        # inside the context: stop() releases the engine's pool-stats
+        # provider, and this snapshot needs the page-pool fields
+        snap = eng.stats()
+    ttfts = [s.ttft for s in streams if s.ttft is not None]
+    recon = (snap["submitted"] == snap["requests"] + snap["rejected"]
+             + snap["shed"] + snap["expired"] + snap["errors"]
+             + snap["cancelled"])
+    return {
+        "prefix_cache": prefix_cache,
+        "makespan_s": round(dt, 4),
+        "requests": len(trace),
+        "tokens": useful,
+        "tokens_per_s": round(useful / dt, 2),
+        "ttft": _pctl(ttfts),
+        "prefix_hit_tokens": snap["prefix_hit_tokens"],
+        "prefix_hit_rate": snap["prefix_hit_rate"],
+        "evictions": snap["evictions"],
+        "kv_pages_high_water": snap["kv_pages_high_water"],
+        "kv_high_water_bytes": snap["kv_high_water_bytes"],
+        "reconciled": bool(recon),
+        **stamp,
+    }, outs
+
+
+def _stall_once(model, slots: int, max_seq: int, chunk: int,
+                long_prompts: List[np.ndarray], victim_new: int
+                ) -> Tuple[float, List[float], float, int]:
+    """One stall measurement: a victim stream decodes while long-prompt
+    requests join; returns (max inter-token gap, all gaps, elapsed,
+    tokens) — the gap is the decode stall a join inflicts (Sarathi's
+    metric)."""
+    import threading
+
+    from .engine import GenerationEngine
+
+    eng = GenerationEngine(model, slots=slots, max_seq=max_seq,
+                           stats_every=0, prefill_chunk=chunk,
+                           prefix_cache="off")
+    gaps: List[float] = []
+    with eng:
+        victim = eng.submit(np.arange(1, 5, dtype=np.int32),
+                            max_new_tokens=victim_new)
+        got = threading.Event()
+
+        def consume():
+            last = time.perf_counter()
+            for _ in victim:
+                now = time.perf_counter()
+                gaps.append(now - last)
+                last = now
+                got.set()
+
+        th = threading.Thread(target=consume, daemon=True)
+        th.start()
+        got.wait(timeout=60)  # victim is decoding before the joins
+        t0 = time.perf_counter()
+        streams = [eng.submit(p, max_new_tokens=2) for p in long_prompts]
+        for s in streams:
+            s.result(timeout=600)
+        victim.result(timeout=600)
+        dt = time.perf_counter() - t0
+        th.join(timeout=60)
+    tokens_done = victim_new + 2 * len(long_prompts)
+    # the first gap includes queue+prefill of the victim itself; the
+    # stall evidence is the max gap AFTER streaming started
+    stall = max(gaps[1:]) if len(gaps) > 1 else 0.0
+    return stall, gaps[1:], dt, tokens_done
+
+
+def _run_stall_arm(model, slots: int, max_seq: int, chunk: int,
+                   long_prompts: List[np.ndarray], victim_new: int,
+                   stamp: Dict, repeats: int = 3) -> Dict:
+    """One chunked-prefill A/B arm, min-of-``repeats``: the max
+    inter-token gap is a MAX statistic, so a single host-scheduler
+    hiccup (GIL, page fault) can dominate one run — the min over
+    repeats is each arm's noise-robust stall floor, the mechanism
+    under test.  ``chunk=0`` is the monolithic baseline."""
+    stalls: List[float] = []
+    gaps_best: List[float] = []
+    total_s = 0.0
+    total_tokens = 0
+    for _ in range(max(1, repeats)):
+        stall, gaps, dt, toks = _stall_once(model, slots, max_seq,
+                                            chunk, long_prompts,
+                                            victim_new)
+        if not stalls or stall < min(stalls):
+            gaps_best = gaps
+        stalls.append(stall)
+        total_s += dt
+        total_tokens += toks
+    return {
+        "prefill_chunk": chunk,
+        "victim_max_gap_ms": round(min(stalls) * 1e3, 3),
+        "victim_max_gap_ms_runs": [round(s * 1e3, 3) for s in stalls],
+        "victim_gap_p50_ms": _pctl(gaps_best)["p50_ms"],
+        "join_prompts": len(long_prompts),
+        "repeats": max(1, repeats),
+        "tokens": total_tokens,
+        "elapsed_s": round(total_s, 4),
+        "tokens_per_s": round(total_tokens / max(1e-6, total_s), 2),
+        **stamp,
+    }
+
+
+def run_prefix_bench(requests: int = 48, slots: int = 8,
+                     max_seq: int = 128, prefix_len: int = 48,
+                     suffix_lo: int = 2, suffix_hi: int = 8,
+                     short_new: int = 4, long_new: int = 24,
+                     long_frac: float = 0.25, d_model: int = 64,
+                     num_heads: int = 4, num_layers: int = 2,
+                     seed: int = 0, prefill_chunk: int = 8,
+                     stall_prompts: int = 6,
+                     stall_prompt_len: int = 112,
+                     calibration_digest=None) -> Dict:
+    """The full --prefix payload (artifacts/gen_prefix_bench_r16.json).
+
+    Acceptance booleans (gated by scripts/check_gen_artifacts.py):
+    prefix-cache TTFT p95 strictly below the no-cache run on the
+    shared-prefix trace with BIT-IDENTICAL tokens, chunked-prefill
+    decode-stall strictly below monolithic at comparable throughput,
+    KV HBM high-water <= the dense baseline at equal slots, and the
+    submitted == terminal-outcomes reconciliation holding in every
+    arm."""
+    import jax
+
+    from ...analysis import comm_plan_digest_for_model
+    from ...search.calibration import device_kind as _device_kind
+
+    model = _build_lm(slots, max_seq, d_model, num_heads, num_layers,
+                      seed)
+    trace = make_prefix_trace(requests, prefix_len, suffix_lo,
+                              suffix_hi, short_new, long_new, long_frac,
+                              seed)
+    dk = _device_kind()
+    stamp = {"device_kind": dk, "calibration_digest": calibration_digest,
+             "comm_plan_digest": comm_plan_digest_for_model(model)}
+
+    # SYMMETRIC best-of-2: both arms run twice over the same compiled
+    # programs (the first pair also absorbs residual warmup) and each
+    # keeps its better p95 — a one-sided min would bias the gated
+    # ttft_cache_win toward the arm that got two samples
+    def best(arm):
+        r1, o1 = _run_prefix_arm(model, trace, slots, max_seq, arm,
+                                 stamp)
+        r2, o2 = _run_prefix_arm(model, trace, slots, max_seq, arm,
+                                 stamp)
+        assert o1 == o2  # determinism within the arm
+        if (r2["ttft"]["p95_ms"] or 1e9) < (r1["ttft"]["p95_ms"] or 1e9):
+            return r2, o2
+        return r1, o1
+
+    on_row, on_outs = best("on")
+    off_row, off_outs = best("off")
+    parity = on_outs == off_outs
+
+    rng = np.random.default_rng(seed + 1)
+    long_prompts = [rng.integers(1, VOCAB,
+                                 stall_prompt_len).astype(np.int32)
+                    for _ in range(stall_prompts)]
+    victim_new = max_seq - 8
+    mono = _run_stall_arm(model, slots, max_seq, 0, long_prompts,
+                          victim_new, stamp)
+    chunked = _run_stall_arm(model, slots, max_seq, prefill_chunk,
+                             long_prompts, victim_new, stamp)
+
+    from ...analysis.kv_memory import dtype_bytes, kv_page_plan
+    plan = kv_page_plan(model.layers, None, slots, max_seq,
+                        kv_dtype_bytes=dtype_bytes(
+                            model.config.compute_dtype))
+    dense_baseline = plan["total_bytes"]  # auto pool == dense worst case
+
+    ttft_win = ((on_row["ttft"]["p95_ms"] or 1e9)
+                < (off_row["ttft"]["p95_ms"] or 0.0))
+    stall_win = (chunked["victim_max_gap_ms"]
+                 < mono["victim_max_gap_ms"])
+    thr_ratio = (chunked["tokens_per_s"]
+                 / max(1e-6, mono["tokens_per_s"]))
+    # STRICT, and also <= the no-cache arm: high_water <= pool size
+    # holds by construction (the pool IS the dense baseline at the
+    # auto size), so a non-strict bound would gate nothing — the claim
+    # under test is that pages-in-use scales with live+shared tokens,
+    # i.e. strictly below a dense preallocation that pins every page
+    hbm_ok = (on_row["kv_high_water_bytes"] < dense_baseline
+              and on_row["kv_high_water_bytes"]
+              <= off_row["kv_high_water_bytes"])
+    recon = bool(on_row["reconciled"] and off_row["reconciled"])
+    payload = {
+        "bench": "gen-prefix",
+        "backend": jax.default_backend(),
+        "estimator": "measured",
+        **stamp,
+        "config": {
+            "requests": requests, "slots": slots, "max_seq": max_seq,
+            "prefix_len": prefix_len,
+            "suffix": f"{suffix_lo}-{suffix_hi}",
+            "short_new": short_new, "long_new": long_new,
+            "long_frac": long_frac, "d_model": d_model,
+            "num_heads": num_heads, "num_layers": num_layers,
+            "seed": seed, "vocab": VOCAB,
+            "page_size": plan["page_size"],
+            "num_pages": plan["num_pages"],
+            "prefill_chunk": prefill_chunk,
+            "stall_prompts": stall_prompts,
+            "stall_prompt_len": stall_prompt_len,
+        },
+        "prefix_cache": {"on": on_row, "off": off_row},
+        "chunked_prefill": {"monolithic": mono, "chunked": chunked,
+                            "throughput_ratio": round(thr_ratio, 3)},
+        "kv_memory": {
+            "dense_baseline_bytes": dense_baseline,
+            "page_bytes": plan["page_bytes"],
+            "high_water_bytes_cache_on": on_row["kv_high_water_bytes"],
+            "high_water_bytes_cache_off":
+                off_row["kv_high_water_bytes"],
+        },
+        "acceptance": {
+            "ttft_cache_win": bool(ttft_win),
+            "prefix_parity": bool(parity),
+            "chunked_stall_win": bool(stall_win),
+            "throughput_comparable": bool(thr_ratio >= 0.8),
+            "hbm_high_water_ok": bool(hbm_ok),
+            "reconciliation_ok": recon,
+        },
+    }
+    return payload
 
 
 def run_generate_bench(requests: int = 96, slots: int = 8,
@@ -347,16 +629,33 @@ def main(argv=None) -> None:
         description="token-generation benchmark: continuous batching "
                     "vs run-to-completion + SLO-goodput sweep "
                     "(docs/serving.md 'Token generation')")
-    ap.add_argument("--requests", type=int, default=96)
+    ap.add_argument("--prefix", action="store_true",
+                    help="run the shared-prefix + chunked-prefill "
+                         "bench instead (paged KV evidence — "
+                         "artifacts/gen_prefix_bench_r16.json)")
+    ap.add_argument("--prefix-len", type=int, default=48,
+                    help="prefix bench: shared system-prompt length")
+    ap.add_argument("--prefill-chunk", type=int, default=8,
+                    help="prefix bench: chunk size for the chunked "
+                         "arm of the decode-stall A/B")
+    # None sentinels for the knobs whose defaults differ per mode
+    # (--generate vs --prefix): value-sniffing "== default" could not
+    # distinguish an explicit 96 from the default 96
+    ap.add_argument("--requests", type=int, default=None,
+                    help="trace size (default 96; 48 under --prefix)")
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--max-seq", type=int, default=128)
     ap.add_argument("--prompt", default="2-8",
-                    help="prompt-length range, e.g. 2-8")
+                    help="prompt-length range, e.g. 2-8 (suffix range "
+                         "under --prefix)")
     ap.add_argument("--short-new", type=int, default=4)
-    ap.add_argument("--long-new", type=int, default=96)
-    ap.add_argument("--long-frac", type=float, default=0.125,
+    ap.add_argument("--long-new", type=int, default=None,
+                    help="long-tail token budget (default 96; 24 "
+                         "under --prefix)")
+    ap.add_argument("--long-frac", type=float, default=None,
                     help="fraction of requests with the long token "
-                         "budget (the chat-like mostly-short mix)")
+                         "budget, the chat-like mostly-short mix "
+                         "(default 0.125; 0.25 under --prefix)")
     ap.add_argument("--d-model", type=int, default=64)
     ap.add_argument("--heads", type=int, default=4)
     ap.add_argument("--layers", type=int, default=2)
@@ -391,12 +690,38 @@ def main(argv=None) -> None:
                      f"{args.calibration!r}: {e}")
 
     from ...fflogger import silenced
+    if args.prefix:
+        with silenced("ff", "serve"):
+            payload = run_prefix_bench(
+                requests=(48 if args.requests is None
+                          else args.requests),
+                slots=args.slots, max_seq=args.max_seq,
+                prefix_len=args.prefix_len, suffix_lo=lo, suffix_hi=hi,
+                short_new=args.short_new,
+                long_new=24 if args.long_new is None else args.long_new,
+                long_frac=(0.25 if args.long_frac is None
+                           else args.long_frac),
+                d_model=args.d_model, num_heads=args.heads,
+                num_layers=args.layers, seed=args.seed,
+                prefill_chunk=args.prefill_chunk,
+                calibration_digest=digest)
+        text = json.dumps(payload, indent=2)
+        print(text)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(text + "\n")
+            print(f"# wrote {args.out}", file=sys.stderr)
+        return
     with silenced("ff", "serve"):
         payload = run_generate_bench(
-            requests=args.requests, slots=args.slots,
+            requests=96 if args.requests is None else args.requests,
+            slots=args.slots,
             max_seq=args.max_seq, prompt_lo=lo, prompt_hi=hi,
-            short_new=args.short_new, long_new=args.long_new,
-            long_frac=args.long_frac, d_model=args.d_model,
+            short_new=args.short_new,
+            long_new=96 if args.long_new is None else args.long_new,
+            long_frac=(0.125 if args.long_frac is None
+                       else args.long_frac),
+            d_model=args.d_model,
             num_heads=args.heads, num_layers=args.layers,
             seed=args.seed, slo_sweep=not args.no_slo_sweep,
             slo_ms=args.slo_ms, mults=mults,

@@ -1,28 +1,39 @@
-"""GraphDecoder — autoregressive execution of an FFModel graph.
+"""GraphDecoder — autoregressive execution of an FFModel graph over a
+PAGED KV cache.
 
 The training/serving executor runs the graph at full sequence length;
-generation needs the same graph one position at a time.  This module
+generation needs the same graph one position at a time against state
+that scales with *live tokens*, not ``slots x max_seq``.  This module
 derives both halves from the layer list itself:
 
-* **prefill** — the full forward over a (1, bucket) padded prompt,
-  through each op's own forward arithmetic (attention uses
-  ``forward_kv``, the LSTM ``forward_states`` — bit-identical to
-  ``forward``), while capturing the per-position K/V (attention) and
-  per-step (h, c) (LSTM) the decode cache is seeded from.  Bucketed:
-  one AOT-style jitted program per power-of-two prompt bucket, like the
-  serving engine's shape buckets.
+* **prefill chunk** — the forward over a ``(1, bucket)`` padded chunk
+  of prompt positions ``start .. start+length-1``, through each op's
+  own forward arithmetic: position-wise ops run unchanged, attention
+  uses :meth:`~flexflow_tpu.ops.attention.MultiHeadAttention.
+  forward_paged` (scatter the chunk's K/V into the slot's pages, attend
+  over the gathered page table — history written by earlier chunks or
+  borrowed from the prefix cache, plus the chunk itself, causally
+  masked on global positions), the LSTM ``forward_states`` (whole-
+  prompt chunks only — cell state cannot page).  One jitted program per
+  power-of-two chunk bucket; a single chunk covering the whole prompt
+  IS the monolithic prefill, so ``serve_prefill_chunk=0`` reproduces
+  the pre-paging behavior program-for-program.
 * **decode** — ONE jitted step for the whole ``slots``-wide decode
   batch: embed the current token per slot, run every layer's
-  single-position path (``Op.decode``), write K/V at each slot's
-  position, argmax the next token.  The cache pytree is donated, so
-  XLA updates the (potentially multi-GB) buffers in place.
+  single-position path, scatter K/V at each slot's
+  ``(write_page, write_row)`` (host-computed; the pool's ``no_page``
+  sentinel drops inactive/prefilling slots' writes), gather each
+  slot's page table and attend, argmax the next token.  The cache
+  pytree is donated, so XLA updates the (potentially multi-GB) pools
+  in place.
 
-Cache geometry and sharding come from
+Pool geometry and sharding come from
 :mod:`flexflow_tpu.analysis.kv_memory` — the SAME module the static
-FF108/FF121 memory gates integrate, so what lint predicts is what this
-decoder allocates.  Heads shard over the tensor-parallel ``c`` mesh
-axis, slots over the data axis ``n`` (never below 2 slots/shard — the
-matrix-vector parity rule).
+FF108/FF121/FF130 memory gates integrate, so what lint predicts is
+what this decoder allocates (the arrays themselves come from
+``pages.alloc_pool_arrays``, the one allocation site RL013 pins).
+Heads shard over the tensor-parallel ``c`` mesh axis; the page dim is
+replicated (pages are interchangeable across slots).
 
 Supported graphs: one (n, s) int token input; position-wise ops
 (dense/norms/elementwise/softmax/dropout/embedding), causal
@@ -34,17 +45,19 @@ silently produce wrong tokens for an unsupported graph.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...analysis.kv_memory import kv_cache_layout
+from ...analysis.kv_memory import (DEFAULT_PAGE_SIZE, default_num_pages,
+                                   kv_cache_layout, pages_per_slot)
 from ...op import OpContext, OpType
 from ...ops.attention import MultiHeadAttention, PositionEmbedding
 from ...ops.linear import Embedding
 from ...ops.rnn import LSTM
+from .pages import alloc_pool_arrays
 
 # ops that act position-wise over the sequence dim: running them on a
 # (slots, 1, d) activation IS the decode step (validated per-op below)
@@ -54,8 +67,10 @@ _POINTWISE_TYPES = (OpType.LINEAR, OpType.LAYERNORM, OpType.RMSNORM,
 
 
 def prefill_buckets(max_seq: int) -> Tuple[int, ...]:
-    """Power-of-two prompt buckets 2, 4, ... capped at ``max_seq``
-    (always included) — one compiled prefill program per bucket."""
+    """Power-of-two chunk buckets 2, 4, ... capped at ``max_seq``
+    (always included) — one compiled prefill-chunk program per bucket.
+    The floor of 2 is the matrix-vector parity rule (a 1-row program's
+    bits drift ~1 ulp, like serve_buckets)."""
     out: List[int] = []
     b = 2
     while b < max_seq:
@@ -66,11 +81,13 @@ def prefill_buckets(max_seq: int) -> Tuple[int, ...]:
 
 
 class GraphDecoder:
-    """Prefill + decode executables for one (model, slots, max_seq)
-    geometry.  Use :meth:`for_model` — instances cache their jitted
-    programs, and engines sharing a geometry share the compiles."""
+    """Prefill-chunk + decode executables for one (model, slots,
+    max_seq, page geometry).  Use :meth:`for_model` — instances cache
+    their jitted programs, and engines sharing a geometry share the
+    compiles."""
 
-    def __init__(self, model, slots: int, max_seq: int):
+    def __init__(self, model, slots: int, max_seq: int,
+                 page_size: int = 0, num_pages: int = 0):
         if slots < 2:
             raise ValueError(
                 f"slots must be >= 2, got {slots}: a 1-slot decode "
@@ -79,12 +96,39 @@ class GraphDecoder:
         self.model = model
         self.slots = int(slots)
         self.max_seq = int(max_seq)
+        cfg = model.config
+        self.page_size = int(page_size
+                             or getattr(cfg, "serve_kv_page", 0)
+                             or DEFAULT_PAGE_SIZE)
+        if self.page_size < 1:
+            raise ValueError(f"page_size must be >= 1, "
+                             f"got {self.page_size}")
+        self.pages_per_slot = pages_per_slot(self.max_seq, self.page_size)
+        self.num_pages = int(num_pages
+                             or getattr(cfg, "serve_kv_pages", 0)
+                             or default_num_pages(self.slots, self.max_seq,
+                                                  self.page_size))
+        if self.num_pages < self.pages_per_slot:
+            raise ValueError(
+                f"num_pages {self.num_pages} cannot hold even one "
+                f"max_seq={self.max_seq} stream "
+                f"({self.pages_per_slot} pages of {self.page_size})")
         self._validate()
         self.buckets = prefill_buckets(self.max_seq)
         mesh = model.mesh
         self._mesh_sizes = dict(mesh.sizes) if mesh is not None else None
         self.layout = kv_cache_layout(model.layers, self._mesh_sizes,
-                                      self.slots, self.max_seq)
+                                      self.slots, self.max_seq,
+                                      page_size=self.page_size,
+                                      num_pages=self.num_pages)
+        self.has_attention = any(isinstance(op, MultiHeadAttention)
+                                 for op in model.layers)
+        self.has_state = any(isinstance(op, LSTM) for op in model.layers)
+        # cell state cannot page: an LSTM chunk at offset k would need
+        # the carry from chunk k-1 as a program input the stateless
+        # forward_states does not take — whole-prompt chunks only, and
+        # no prefix reuse (the engine enforces both)
+        self.supports_chunking = not self.has_state
         self._prefill_fns: Dict[int, object] = {}
         self._decode_fn = None
 
@@ -153,95 +197,79 @@ class GraphDecoder:
 
     # ---- cache ---------------------------------------------------------
     def init_cache(self) -> Dict[str, Dict[str, jax.Array]]:
-        """Preallocate the per-slot decode state, placed under the
-        layout's PartitionSpecs (analysis.kv_memory — the bytes the
-        FF108/FF121 gates charge are exactly these allocations)."""
-        from jax.sharding import PartitionSpec
-
-        mesh = self.model.mesh
-        compute_dt = jnp.dtype(self.model.config.compute_dtype)
-        caches: Dict[str, Dict[str, jax.Array]] = {}
-        for name, ent in self.layout.items():
-            dt = compute_dt if ent["dtype"] == "compute" else jnp.float32
-            sub: Dict[str, jax.Array] = {}
-            for leaf, shape in ent["shapes"].items():
-                arr = jnp.zeros(shape, dt)
-                if mesh is not None and mesh.is_distributed:
-                    arr = jax.device_put(
-                        arr,
-                        mesh.sharding(PartitionSpec(
-                            *ent["entries"][leaf])))
-                sub[leaf] = arr
-            caches[name] = sub
-        return caches
+        """Preallocate the page pools + LSTM state, placed under the
+        layout's PartitionSpecs — through ``pages.alloc_pool_arrays``,
+        the ONE KV allocation site (RL013; the bytes the
+        FF108/FF121/FF130 gates charge are exactly these
+        allocations)."""
+        return alloc_pool_arrays(self.layout, self.model.mesh,
+                                 self.model.config.compute_dtype)
 
     # ---- prefill -------------------------------------------------------
-    def prefill_bucket(self, prompt_len: int) -> int:
-        """Smallest prompt bucket covering ``prompt_len``."""
+    def prefill_bucket(self, chunk_len: int) -> int:
+        """Smallest chunk bucket covering ``chunk_len``."""
         for b in self.buckets:
-            if b >= prompt_len:
+            if b >= chunk_len:
                 return b
-        raise ValueError(f"prompt of {prompt_len} tokens exceeds "
+        raise ValueError(f"prefill chunk of {chunk_len} tokens exceeds "
                          f"max_seq {self.max_seq}")
 
-    def _walk_prefill(self, params, tokens):
-        """Full forward over (1, bucket) tokens, collecting each
-        cache-bearing op's seed tensors.  Runs the ops' OWN forward
-        arithmetic (forward_kv/forward_states are forward plus extra
-        outputs), so prefill == the training executor's forward."""
-        ctx = self._ctx()
-        values: Dict[int, jax.Array] = {self._input_uid: tokens}
-        seeds: Dict[str, Dict[str, jax.Array]] = {}
-        for op in self.model.layers:
-            ins = [values[t.uid] for t in op.inputs]
-            if isinstance(op, MultiHeadAttention):
-                outs, k, v = op.forward_kv(params, ins, ctx)
-                seeds[op.name] = {"k": k, "v": v}
-            elif isinstance(op, LSTM):
-                outs, hs, cs = op.forward_states(params, ins, ctx)
-                seeds[op.name] = {"hs": hs, "cs": cs}
-            else:
-                outs = op.forward(params, ins, ctx)
-            for t, val in zip(op.outputs, outs):
-                values[t.uid] = val
-        return values[self._final_uid], seeds
-
     def prefill_fn(self, bucket: int):
-        """The jitted prefill program for one prompt bucket:
-        ``fn(params, caches, tokens (1, bucket), slot, length) ->
-        (first_token, caches)`` — runs the full forward, writes the
-        slot's K/V rows / gathers its (h, c) at ``length - 1``, and
-        argmaxes the last prompt position's logits (the stream's FIRST
-        generated token, so TTFT is one prefill dispatch).  The cache
-        pytree is donated."""
+        """The jitted prefill-CHUNK program for one bucket:
+        ``fn(params, caches, tokens (1, bucket), table_row
+        (pages_per_slot,), slot, start, length) -> (next_token,
+        caches)`` — runs the forward over chunk positions ``start ..
+        start+length-1``, scatters the chunk's K/V into the slot's
+        pages / writes the LSTM carry at ``length - 1``, and argmaxes
+        the chunk's last real position's logits.  For the FINAL chunk
+        that argmax is the stream's FIRST generated token (TTFT is the
+        last chunk's dispatch); intermediate chunks' return value is
+        ignored.  The cache pytree is donated."""
         fn = self._prefill_fns.get(bucket)
         if fn is not None:
             return fn
         if bucket not in self.buckets:
             raise ValueError(f"unknown prefill bucket {bucket}")
+        layers = self.model.layers
 
-        def prefill(params, caches, tokens, slot, length):
-            logits, seeds = self._walk_prefill(params, tokens)
+        def prefill(params, caches, tokens, table_row, slot, start,
+                    length):
+            ctx = self._ctx()
+            values: Dict[int, jax.Array] = {self._input_uid: tokens}
             new = {name: dict(sub) for name, sub in caches.items()}
-            for name, seed in seeds.items():
-                if "k" in seed:
-                    new[name]["k"] = jax.lax.dynamic_update_slice(
-                        new[name]["k"], seed["k"], (slot, 0, 0, 0))
-                    new[name]["v"] = jax.lax.dynamic_update_slice(
-                        new[name]["v"], seed["v"], (slot, 0, 0, 0))
-                else:
+            for op in layers:
+                ins = [values[t.uid] for t in op.inputs]
+                if isinstance(op, MultiHeadAttention):
+                    outs, kp, vp = op.forward_paged(
+                        params, ins[0], new[op.name]["k"],
+                        new[op.name]["v"], table_row, start, length, ctx)
+                    new[op.name] = {"k": kp, "v": vp}
+                elif isinstance(op, LSTM):
+                    # whole-prompt chunk only (supports_chunking False):
+                    # start == 0, so forward_states' zero-state scan is
+                    # exactly the monolithic prefill
+                    outs, hs, cs = op.forward_states(params, ins, ctx)
                     h_sel = jax.lax.dynamic_index_in_dim(
-                        seed["hs"], length - 1, axis=1, keepdims=False)
+                        hs, length - 1, axis=1, keepdims=False)
                     c_sel = jax.lax.dynamic_index_in_dim(
-                        seed["cs"], length - 1, axis=1, keepdims=False)
-                    new[name]["h"] = jax.lax.dynamic_update_slice(
-                        new[name]["h"], h_sel, (slot, 0))
-                    new[name]["c"] = jax.lax.dynamic_update_slice(
-                        new[name]["c"], c_sel, (slot, 0))
+                        cs, length - 1, axis=1, keepdims=False)
+                    new[op.name] = {
+                        "h": jax.lax.dynamic_update_slice(
+                            new[op.name]["h"], h_sel, (slot, 0)),
+                        "c": jax.lax.dynamic_update_slice(
+                            new[op.name]["c"], c_sel, (slot, 0)),
+                    }
+                elif isinstance(op, PositionEmbedding):
+                    outs = op.forward_at(params, ins[0], start, ctx)
+                else:
+                    outs = op.forward(params, ins, ctx)
+                for t, val in zip(op.outputs, outs):
+                    values[t.uid] = val
+            logits = values[self._final_uid]
             last = jax.lax.dynamic_index_in_dim(
                 logits, length - 1, axis=1, keepdims=False)[0]
-            first = jnp.argmax(last).astype(jnp.int32)
-            return first, new
+            nxt = jnp.argmax(last).astype(jnp.int32)
+            return nxt, new
 
         fn = jax.jit(prefill, donate_argnums=(1,))
         self._prefill_fns[bucket] = fn
@@ -250,19 +278,22 @@ class GraphDecoder:
     # ---- decode --------------------------------------------------------
     def decode_fn(self):
         """THE decode step, jitted once per geometry:
-        ``fn(params, caches, tokens (slots,), pos (slots,)) ->
-        (next_tokens (slots,), caches)``.  Every slot advances one
-        position per call — inactive slots compute on dummy inputs
-        (their cache rows are dead and rewritten at the next prefill),
-        which keeps the program shape static.  Greedy argmax decoding:
-        deterministic, and exactly what the replicated
-        ``predict``-style reference does — the engine==reference parity
-        pin compares token ids."""
+        ``fn(params, caches, tokens (slots,), pos (slots,), table
+        (slots, pages_per_slot), write_pages (slots,), write_rows
+        (slots,)) -> (next_tokens (slots,), caches)``.  Every slot
+        advances one position per call — inactive/prefilling slots
+        compute on dummy inputs with ``write_pages`` at the pool's OOB
+        sentinel (their scatter drops; a write through a stale table
+        entry could corrupt a SHARED prefix page), which keeps the
+        program shape static.  Greedy argmax decoding: deterministic,
+        and exactly what the replicated ``predict``-style reference
+        does — the engine==reference parity pin compares token ids."""
         if self._decode_fn is not None:
             return self._decode_fn
         layers = self.model.layers
 
-        def decode(params, caches, tokens, pos):
+        def decode(params, caches, tokens, pos, table, write_pages,
+                   write_rows):
             ctx = self._ctx()
             x = tokens[:, None]                          # (slots, 1)
             values: Dict[int, jax.Array] = {self._input_uid: x}
@@ -270,10 +301,11 @@ class GraphDecoder:
             for op in layers:
                 ins = [values[t.uid] for t in op.inputs]
                 if isinstance(op, MultiHeadAttention):
-                    outs, k2, v2 = op.decode(
+                    outs, kp, vp = op.decode_paged(
                         params, ins[0], caches[op.name]["k"],
-                        caches[op.name]["v"], pos, ctx)
-                    new[op.name] = {"k": k2, "v": v2}
+                        caches[op.name]["v"], table, pos,
+                        write_pages, write_rows, ctx)
+                    new[op.name] = {"k": kp, "v": vp}
                 elif isinstance(op, LSTM):
                     outs, h2, c2 = op.decode(
                         params, ins[0], caches[op.name]["h"],
@@ -294,15 +326,31 @@ class GraphDecoder:
 
     # ---- shared-instance registry --------------------------------------
     @classmethod
-    def for_model(cls, model, slots: int, max_seq: int) -> "GraphDecoder":
-        """One decoder per (model, slots, max_seq): engines sharing a
-        geometry share the jitted prefill/decode programs (the compile
-        cost is the startup cost, like the serving engine's bucket
-        warmup)."""
+    def for_model(cls, model, slots: int, max_seq: int,
+                  page_size: int = 0, num_pages: int = 0
+                  ) -> "GraphDecoder":
+        """One decoder per (model, slots, max_seq, page geometry):
+        engines sharing a geometry share the jitted prefill/decode
+        programs (the compile cost is the startup cost, like the
+        serving engine's bucket warmup).  The key is the RESOLVED
+        geometry, not the raw args: a 0-default key would pin the
+        FIRST construction's config values (a later
+        ``cfg.serve_kv_page`` change would silently get the stale
+        decoder), and an explicit value equal to the default would
+        duplicate identical compiles under a second key."""
+        cfg = model.config
+        ps = int(page_size
+                 or getattr(cfg, "serve_kv_page", 0)
+                 or DEFAULT_PAGE_SIZE)
+        pool = int(num_pages
+                   or getattr(cfg, "serve_kv_pages", 0)
+                   or (default_num_pages(slots, max_seq, ps)
+                       if ps > 0 else 0))
         reg = model.__dict__.setdefault("_gen_decoders", {})
-        key = (int(slots), int(max_seq))
+        key = (int(slots), int(max_seq), ps, pool)
         dec = reg.get(key)
         if dec is None:
-            dec = cls(model, slots, max_seq)
+            dec = cls(model, slots, max_seq, page_size=ps,
+                      num_pages=pool)
             reg[key] = dec
         return dec

@@ -1,5 +1,6 @@
 """GenerationEngine — iteration-level continuous batching over the
-KV-cached decode path (docs/serving.md "Token generation").
+PAGED KV-cached decode path (docs/serving.md "Token generation" +
+"Paged KV & prefix caching").
 
 The fixed-shape :class:`~flexflow_tpu.serving.engine.ServingEngine`
 coalesces whole requests into one dispatch; token generation is a
@@ -7,12 +8,29 @@ different shape of problem — a request is a *stream* whose cost is
 unknown up front (EOS may land anywhere).  Run-to-completion batching
 wastes every slot whose stream finished early, so this engine schedules
 at ITERATION granularity: a fixed ``slots``-wide decode batch shares
-one preallocated KV cache, requests join a free slot at any step
-boundary (one bucketed prefill dispatch seeds the slot and yields the
-stream's first token — that's TTFT), every step runs ONE decode
-dispatch + ONE token fetch for the whole batch (repo_lint RL010 bans
-any other host sync in the loop), and a finished/cancelled stream frees
-its slot for the next queued prompt immediately.
+one KV **page pool**, requests join a free slot at any step boundary,
+every step runs ONE decode dispatch + ONE token fetch for the whole
+batch (repo_lint RL010 bans any other host sync in the loop), and a
+finished/cancelled stream frees its slot — and its pages — immediately.
+
+Three ISSUE 15 mechanisms ride on the page pool:
+
+* **Paged KV** — per-slot state is a page table of gather indices into
+  fixed-size pool pages (``pages.KVPagePool``), so HBM-in-use scales
+  with live tokens; ``analysis.kv_memory.kv_page_plan`` is the ONE
+  accounting both this engine and lint/explain/the fleet gate read.
+* **Shared-prefix reuse** — a ref-counted trie over full pages of
+  prompt token ids (``pages.PrefixCache``): a prompt extending a
+  cached prefix borrows the shared pages and prefills only its suffix.
+  Shared pages are immutable by construction (see pages.py), LRU
+  eviction frees unreferenced ones under pool pressure, and
+  ``serve_prefix_cache=off`` disables the whole path with bit-identical
+  tokens either way — the correctness anchor.
+* **Chunked prefill** — long prompts prefill in ``serve_prefill_chunk``
+  -token chunks, at most ONE chunk per decode-step boundary
+  (Sarathi-style), so a long join stalls in-flight streams by one
+  bounded chunk instead of one monolithic prompt.  ``0`` = whole-prompt
+  chunks (the pre-paging behavior, program-for-program).
 
 Admission reuses PR 8's machinery unchanged: the same
 :class:`~flexflow_tpu.serving.batcher.MicroBatcher` (1 row per request)
@@ -24,7 +42,7 @@ anti-starvation aging bound — overload semantics carry over verbatim.
 Strategy-sharded serving: :meth:`GenerationEngine.from_strategy` loads
 a searched ``.pb``, re-places the params under the strategy's
 PartitionSpecs (the SNIPPETS partition-rule → spec-pytree pattern) and
-shards the KV cache heads over the ``c`` mesh axis / slots over ``n``
+shards the pool's head dim over the ``c`` mesh axis
 (analysis.kv_memory), so one checkpoint decodes tensor-parallel over
 whatever mesh the strategy was searched for.
 """
@@ -48,9 +66,11 @@ from ...obs.flight import flight_dump, get_flight
 from ...obs.trace import phase_of, tracer_from_config
 from ...profiling import quantiles
 from ..batcher import MicroBatcher, Request
-from ..errors import GenerationCancelled, OverloadError, SheddedError
+from ..errors import (GenerationCancelled, KVCacheExhausted,
+                      OverloadError, SheddedError)
 from ..metrics import ServingMetrics
 from .decoder import GraphDecoder
+from .pages import KVPagePool, PrefixCache
 
 _END = object()  # token-stream sentinel
 
@@ -87,8 +107,10 @@ class GenerationStream:
         final = stream.result()     # np.int32 array of all new tokens
 
     ``cancel()`` is safe at any time: a queued request is dropped
-    before any prefill; a mid-generation cancel frees its KV slot at
-    the next step boundary and fails ONLY this stream with
+    before any prefill; a cancel landing mid-prefill (between chunks,
+    or between the prefill dispatch and its scatter) or mid-generation
+    frees its KV slot AND pages at the next step boundary and fails
+    ONLY this stream with
     :class:`~flexflow_tpu.serving.errors.GenerationCancelled` — tokens
     already iterated remain valid."""
 
@@ -105,16 +127,17 @@ class GenerationStream:
         self._q: "queue.SimpleQueue" = queue.SimpleQueue()
         self._tokens: List[int] = []  # engine-thread writes, then frozen
         self._cancelled = threading.Event()
-        # submit -> first token, set by the engine at prefill (None
-        # until then) — per-stream SLO evidence for the goodput sweep
+        # submit -> first token, set by the engine at the final prefill
+        # chunk (None until then) — per-stream SLO evidence for the
+        # goodput sweep
         self.ttft: Optional[float] = None
 
     # ---- client side ---------------------------------------------------
     def cancel(self) -> None:
         """Request cancellation.  Queued: the engine drops the request
-        without a prefill (the future flips cancelled).  Generating:
-        the slot frees at the next step boundary and the future fails
-        with GenerationCancelled."""
+        without a prefill (the future flips cancelled).  Prefilling or
+        generating: the slot and its pages free at the next step
+        boundary and the future fails with GenerationCancelled."""
         self._cancelled.set()
         # succeeds only while still queued (the engine claims the
         # future before prefill); a claimed future fails at the next
@@ -184,29 +207,47 @@ class _GenRequest(Request):
 
 
 class _Slot:
-    """Dispatcher-thread-only state of one active decode slot."""
+    """Dispatcher-thread-only state of one decode slot: its stream,
+    its page list (prefix-cache hits first, private pages after), and
+    its prefill progress.  ``prefilling`` slots own pages but are
+    excluded from decode dispatch writes (their write page rides the
+    pool's OOB sentinel)."""
 
-    __slots__ = ("stream", "last_token", "length", "generated")
+    __slots__ = ("stream", "prompt", "pages", "hit_tokens", "next_pos",
+                 "chunks", "last_token", "length", "generated",
+                 "prefilling", "t_join")
 
-    def __init__(self, stream: GenerationStream, first_token: int,
-                 prompt_len: int):
+    def __init__(self, stream: GenerationStream, prompt: np.ndarray,
+                 hit_pages: List[int], page_size: int, t_join: float):
         self.stream = stream
-        self.last_token = first_token
-        self.length = prompt_len  # positions materialized in the cache
-        self.generated = 1        # prefill already yielded token #1
+        self.prompt = prompt
+        self.pages: List[int] = list(hit_pages)
+        self.hit_tokens = len(hit_pages) * int(page_size)
+        self.next_pos = self.hit_tokens  # next prompt position to prefill
+        self.chunks = 0
+        self.last_token = 0
+        self.length = 0     # positions materialized in the cache
+        self.generated = 0
+        self.prefilling = True
+        self.t_join = t_join
 
 
 class GenerationMetrics(ServingMetrics):
     """ServingMetrics plus the generation gauges: windowed tokens/s,
     TTFT (submit -> first token, i.e. queue wait + prefill) and TPOT
     (decode-step wall time — the per-token latency every active stream
-    pays) percentiles, token/prefill totals.  Emitted as ``gen_stats``
-    events, the generation analogue of ``serve_stats``."""
+    pays) percentiles, token/prefill totals, and — when the engine
+    wires ``pool_stats_fn`` — the page-pool view (kv_pages_in_use,
+    prefix_hit_rate, evictions, prefill_chunks).  Emitted as
+    ``gen_stats`` events, the generation analogue of ``serve_stats``."""
 
     def __init__(self, **kw):
         super().__init__(**kw)
         self._ttfts: deque = deque(maxlen=4096)  # guarded_by: self._lock
         self._steps: deque = deque()             # guarded_by: self._lock
+        # the engine's page-pool/prefix-cache snapshot provider (plain
+        # attribute like queue_depth_fn; released with it)
+        self.pool_stats_fn = None
         # token/prefill lifetime totals live in the obs.registry like
         # every other serving counter — gen_stats events and /metrics
         # read the same children (docs/observability.md "Metrics")
@@ -219,7 +260,7 @@ class GenerationMetrics(ServingMetrics):
             "ff_gen_tokens_total", "Tokens generated (incl. the "
             "prefill's first token)", ("model", "eng"))
         self._fams["prefills"] = reg.counter(
-            "ff_gen_prefills_total", "Prefill dispatches (stream "
+            "ff_gen_prefills_total", "Prefill completions (stream "
             "joins)", ("model", "eng"))
         self._ctr["tokens"] = self._fams["tokens"].labels(**kv)
         self._ctr["prefills"] = self._fams["prefills"].labels(**kv)
@@ -259,6 +300,12 @@ class GenerationMetrics(ServingMetrics):
             while self._steps and self._steps[0][0] < horizon:
                 self._steps.popleft()
 
+    def release(self) -> None:
+        # drop the engine-owned pool provider with the queue-depth one
+        # (a retired engine must not be retained by the registry)
+        self.pool_stats_fn = None
+        super().release()
+
     def snapshot(self) -> Dict:
         snap = super().snapshot()
         now = self.clock()
@@ -287,6 +334,9 @@ class GenerationMetrics(ServingMetrics):
             "tpot_p50_ms": ms(qp[0.5]), "tpot_p95_ms": ms(qp[0.95]),
             "tpot_p99_ms": ms(qp[0.99]),
         })
+        fn = self.pool_stats_fn
+        if fn is not None:
+            snap.update(fn())
         return snap
 
     def emit(self, extra: Dict | None = None) -> None:
@@ -307,7 +357,9 @@ class GenerationEngine:
             out = stream.result()
 
     Knobs resolve from ``model.config`` (``--serve-gen-slots``,
-    ``--serve-gen-max-seq``, ``--serve-gen-max-new``, and PR 8's
+    ``--serve-gen-max-seq``, ``--serve-gen-max-new``, the paged-KV
+    knobs ``--serve-kv-page``/``--serve-kv-pages``/
+    ``--serve-prefix-cache``/``--serve-prefill-chunk``, and PR 8's
     ``--serve-max-queue-rows``/``--serve-admission``/
     ``--serve-starvation-ms`` for admission — the queue bound counts
     REQUESTS here, one row each) unless overridden.  ``clock``/``sleep``
@@ -320,6 +372,10 @@ class GenerationEngine:
                  max_queue_requests: Optional[int] = None,
                  admission: Optional[str] = None,
                  starvation_ms: Optional[float] = None,
+                 page_size: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 prefix_cache: Optional[str] = None,
                  stats_every: int = 32, metrics_window_s: float = 30.0,
                  clock=time.monotonic, sleep=time.sleep,
                  name: str = ""):
@@ -372,21 +428,60 @@ class GenerationEngine:
         # flight taps installed for post-mortem dumps
         self._tracer = tracer_from_config(cfg)
         get_flight()
-        self._decoder = GraphDecoder.for_model(model, self.slots,
-                                               self.max_seq)
+        self._decoder = GraphDecoder.for_model(
+            model, self.slots, self.max_seq,
+            page_size=int(page_size or 0), num_pages=int(num_pages or 0))
+        self.page_size = self._decoder.page_size
+        self.num_pages = self._decoder.num_pages
         # the ONE KV accounting (analysis.kv_memory): what lint's
-        # FF108/FF121 gates charge for this deployment is what
-        # init_cache() allocates
-        from ...analysis.kv_memory import dtype_bytes, kv_cache_bytes
-        self.kv_cache_bytes = kv_cache_bytes(
+        # FF108/FF121 gates and the fleet's FF130 gate charge for this
+        # deployment is what the pool actually allocates
+        from ...analysis.kv_memory import dtype_bytes, kv_page_plan
+        self.kv_plan = kv_page_plan(
             model.layers,
             dict(model.mesh.sizes) if model.mesh is not None else None,
             self.slots, self.max_seq,
-            kv_dtype_bytes=dtype_bytes(cfg.compute_dtype))
+            kv_dtype_bytes=dtype_bytes(cfg.compute_dtype),
+            page_size=self.page_size, num_pages=self.num_pages)
+        self.kv_cache_bytes = self.kv_plan["total_bytes"]
+        # chunked prefill: at most one chunk per step boundary; 0 =
+        # whole-prompt chunks (the monolithic baseline).  LSTM graphs
+        # cannot chunk (cell state is not a program input mid-prompt).
+        chunk = int(cfg.serve_prefill_chunk if prefill_chunk is None
+                    else prefill_chunk)
+        if chunk < 0:
+            raise ValueError(f"serve_prefill_chunk must be >= 0, "
+                             f"got {chunk}")
+        self.prefill_chunk = (chunk if self._decoder.supports_chunking
+                              else 0)
+        # shared-prefix cache: on unless configured off; needs the
+        # paged attention path (and whole-prompt LSTM graphs have no
+        # pageable state to share)
+        pc = (cfg.serve_prefix_cache if prefix_cache is None
+              else prefix_cache)
+        self.prefix_cache_enabled = (
+            str(pc).lower() not in ("off", "0", "false", "no")
+            and self._decoder.has_attention
+            and self._decoder.supports_chunking)
         # dispatcher-thread-only state (single writer, no lock)
         self._slots_state: List[Optional[_Slot]] = [None] * self.slots
+        self._pool = KVPagePool(self.num_pages, self.page_size)
+        self._prefix: Optional[PrefixCache] = (
+            PrefixCache(self._pool) if self.prefix_cache_enabled
+            else None)
+        self._table = np.full((self.slots, self._decoder.pages_per_slot),
+                              self._pool.no_page, np.int32)
+        self._prefill_q: deque = deque()  # (slot, _Slot) FIFO
         self._caches = None
         self._n_steps = 0
+        self._chunks_total = 0
+        self._hit_tokens = 0
+        self._prompt_tokens = 0
+        # lifetime counters preserved across pool rebuilds (a poisoned
+        # dispatch rebuilds pool+prefix; totals must stay monotonic)
+        self._evictions_base = 0
+        self._pool_high_base = 0
+        self.metrics.pool_stats_fn = self._pool_stats
         self._gen_faults: List[Dict] = []
         # lifecycle (same single-use contract as ServingEngine)
         self._thread: Optional[  # guarded_by: self._lifecycle
@@ -403,22 +498,26 @@ class GenerationEngine:
     def _warmup(self) -> None:
         """Compile every program the engine can dispatch BEFORE
         serving — the generation edition of ServingEngine's bucket
-        warmup.  A prefill bucket compiled lazily mid-serving stalls
+        warmup.  A chunk bucket compiled lazily mid-serving stalls
         the whole decode batch for the compile (measured ~0.6 s/bucket
         on CPU — every in-flight stream's TPOT eats it); paying all of
         it at start() keeps steady-state latency flat.  The dummy
-        dispatches write into slot 0 / position 0 of the fresh cache,
-        which the first real prefill overwrites."""
+        dispatches ride an all-sentinel page table, so every pool
+        write DROPS — warmup leaves the pool bit-clean."""
         params = self.model._params
-        tok0 = np.zeros((1, 1), np.int32)
+        no_table = np.full((self._decoder.pages_per_slot,),
+                           self._pool.no_page, np.int32)
         for b in self._decoder.buckets:
             fn = self._decoder.prefill_fn(b)
             tokens = np.zeros((1, b), np.int32)
-            tokens[0, :1] = tok0[0]
-            first, self._caches = fn(params, self._caches, tokens,
-                                     np.int32(0), np.int32(1))
+            _, self._caches = fn(params, self._caches, tokens, no_table,
+                                 np.int32(0), np.int32(0), np.int32(1))
         nxt, self._caches = self._decoder.decode_fn()(
             params, self._caches, np.zeros((self.slots,), np.int32),
+            np.zeros((self.slots,), np.int32),
+            np.full((self.slots, self._decoder.pages_per_slot),
+                    self._pool.no_page, np.int32),
+            np.full((self.slots,), self._pool.no_page, np.int32),
             np.zeros((self.slots,), np.int32))
         jax.device_get(nxt)
 
@@ -438,6 +537,11 @@ class GenerationEngine:
                     "gen_engine_start", model=self.name, slots=self.slots,
                     max_seq=self.max_seq,
                     kv_cache_bytes=self.kv_cache_bytes,
+                    kv_page_size=self.page_size,
+                    kv_num_pages=self.num_pages,
+                    prefix_cache=("on" if self.prefix_cache_enabled
+                                  else "off"),
+                    prefill_chunk=self.prefill_chunk,
                     admission=self.admission,
                     max_queue_requests=self.max_queue_requests)
                 self._thread = threading.Thread(
@@ -555,6 +659,11 @@ class GenerationEngine:
                     "gen_engine_start", model=self.name, slots=self.slots,
                     max_seq=self.max_seq,
                     kv_cache_bytes=self.kv_cache_bytes,
+                    kv_page_size=self.page_size,
+                    kv_num_pages=self.num_pages,
+                    prefix_cache=("on" if self.prefix_cache_enabled
+                                  else "off"),
+                    prefill_chunk=self.prefill_chunk,
                     admission=self.admission,
                     max_queue_requests=self.max_queue_requests,
                     external=True)
@@ -562,17 +671,21 @@ class GenerationEngine:
 
     def dispatch_pending(self) -> Optional[float]:
         """Externally-driven decode step (fleet mode): expire queued
-        deadlines, join queued prompts into free slots (prefill), and
-        advance every active stream one token.  Returns the wall
-        seconds spent — the device-time the fleet's fair scheduler
-        charges this tenant — or None when nothing was due.  Error
-        containment matches the owned decode loop (a poisoned step
-        fails the active streams, the engine keeps serving)."""
+        deadlines, join queued prompts into free slots, advance prefill
+        by at most one chunk, and advance every active stream one
+        token.  Returns the wall seconds spent — the device-time the
+        fleet's fair scheduler charges this tenant — or None when
+        nothing was due.  Error containment matches the owned decode
+        loop (a poisoned step fails the active streams, the engine
+        keeps serving)."""
         t0 = self.clock()
         self._batcher.reap_expired()
         self._admit()
-        if not any(s is not None for s in self._slots_state):
-            return None  # no active streams, nothing queued joined
+        progressed = self._prefill_step()
+        self._grow_active_pages()
+        if not any(s is not None and not s.prefilling
+                   for s in self._slots_state):
+            return max(0.0, self.clock() - t0) if progressed else None
         self._fire_slow_decode()
         try:
             self._decode_once()
@@ -585,7 +698,8 @@ class GenerationEngine:
     @property
     def has_pending(self) -> bool:
         """Whether the engine has work an external dispatcher should
-        schedule: active decode slots or queued prompts."""
+        schedule: occupied decode slots (active or prefilling) or
+        queued prompts."""
         return (any(s is not None for s in self._slots_state)
                 or self._batcher.queue_depth > 0)
 
@@ -679,11 +793,39 @@ class GenerationEngine:
             tid=self.name or "generate", phase=phase,
             tokens=len(stream._tokens), model=self.name)
 
+    def _pool_stats(self) -> Dict:
+        """The page-pool/prefix-cache snapshot merged into gen_stats
+        and stats() — lifetime counters stay monotonic across the
+        pool rebuilds a poisoned dispatch forces."""
+        pool = self._pool
+        prefix = self._prefix
+        hw = max(self._pool_high_base, pool.high_water)
+        prompt_toks = self._prompt_tokens
+        return {
+            "kv_page_size": self.page_size,
+            "kv_num_pages": self.num_pages,
+            "kv_pages_in_use": pool.pages_in_use,
+            "kv_pages_high_water": hw,
+            "kv_high_water_bytes":
+                hw * self.kv_plan["page_bytes"]
+                + self.kv_plan["state_bytes"],
+            "prefix_cache": "on" if prefix is not None else "off",
+            "prefix_hit_tokens": self._hit_tokens,
+            "prefix_hit_rate": (round(self._hit_tokens
+                                      / prompt_toks, 4)
+                                if prompt_toks else 0.0),
+            "prefix_pages_cached": len(prefix) if prefix else 0,
+            "evictions": (self._evictions_base
+                          + (prefix.evictions if prefix else 0)),
+            "prefill_chunks": self._chunks_total,
+        }
+
     def stats(self) -> Dict:
         active = sum(1 for s in self._slots_state if s is not None)
         return {**self.metrics.snapshot(), "slots": self.slots,
                 "active_slots": active, "max_seq": self.max_seq,
                 "kv_cache_bytes": self.kv_cache_bytes,
+                "prefill_chunk": self.prefill_chunk,
                 "admission": self.admission,
                 "max_queue_requests": self.max_queue_requests,
                 "peak_queue_requests": self._batcher.peak_rows}
@@ -691,7 +833,8 @@ class GenerationEngine:
     # ---- dispatcher thread ---------------------------------------------
     def _decode_loop(self) -> None:
         """One iteration per decode step: admit queued prompts into
-        free slots (prefill), then advance every active stream by one
+        free slots, advance prefill by AT MOST one chunk (the
+        decode-stall cap), then advance every active stream by one
         token with ONE dispatch + ONE fetch (RL010)."""
         while True:
             if self._abort.is_set():
@@ -703,27 +846,36 @@ class GenerationEngine:
             # happens to free
             self._batcher.reap_expired()
             self._admit()
-            if not any(s is not None for s in self._slots_state):
-                reqs = self._batcher.next_batch(timeout=0.05)
-                if reqs:
-                    for r in reqs:
-                        self._join(r)
-                    continue
-                if (self._closing.is_set()
-                        and self._batcher.queue_depth == 0):
-                    return
+            progressed = self._prefill_step()
+            self._grow_active_pages()
+            if any(s is not None and not s.prefilling
+                   for s in self._slots_state):
+                self._fire_slow_decode()
+                try:
+                    self._decode_once()
+                except BaseException as e:  # noqa: BLE001 — one
+                    # poisoned step must fail the ACTIVE streams, not
+                    # kill the dispatcher; queued prompts still served
+                    self._recover_from_dispatch_error(e,
+                                                      "gen_decode_error")
                 continue
-            self._fire_slow_decode()
-            try:
-                self._decode_once()
-            except BaseException as e:  # noqa: BLE001 — one poisoned
-                # step must fail the ACTIVE streams, not kill the
-                # dispatcher; queued prompts still get served
-                self._recover_from_dispatch_error(e, "gen_decode_error")
+            if progressed or any(s is not None
+                                 for s in self._slots_state):
+                continue  # prefill still in flight: keep chunking
+            reqs = self._batcher.next_batch(timeout=0.05)
+            if reqs:
+                for r in reqs:
+                    self._assign(r)
+                continue
+            if (self._closing.is_set()
+                    and self._batcher.queue_depth == 0):
+                return
 
     def _admit(self) -> None:
         """Join queued prompts into free slots at the step boundary —
-        the continuous-batching join point."""
+        the continuous-batching join point.  Assignment is instant
+        (prefix-cache lookup + slot bookkeeping); the prefill itself
+        runs chunk-by-chunk at later boundaries."""
         for slot in range(self.slots):
             if self._slots_state[slot] is not None:
                 continue
@@ -731,10 +883,11 @@ class GenerationEngine:
             if not batch:
                 return
             for r in batch:
-                self._join(r, slot)
+                self._assign(r, slot)
 
-    def _join(self, req: _GenRequest, slot: Optional[int] = None) -> None:
-        if slot is None:
+    def _assign(self, req: _GenRequest,
+                slot: Optional[int] = None) -> None:
+        if slot is None or self._slots_state[slot] is not None:
             slot = next((i for i, s in enumerate(self._slots_state)
                          if s is None), None)
             if slot is None:
@@ -752,56 +905,200 @@ class GenerationEngine:
             return  # cancelled/expired while queued (the cancel was
             #         counted at cancel() time — see submit())
         prompt = req.xs[0]
-        traced = self._tracer.active
-        t_join = self.clock() if traced else 0.0
+        hits: List[int] = []
+        if self._prefix is not None:
+            hits = self._prefix.lookup(prompt)
+        st = _Slot(stream, prompt, hits, self.page_size, self.clock())
+        for i, pg in enumerate(hits):
+            self._table[slot, i] = pg
+        self._slots_state[slot] = st
+        self._prefill_q.append((slot, st))
+        self._prompt_tokens += int(prompt.size)
+        self._hit_tokens += st.hit_tokens
+
+    # ---- paged prefill (chunked) ---------------------------------------
+    def _prefill_step(self) -> bool:
+        """Advance prefill by AT MOST one chunk dispatch per step
+        boundary (Sarathi-style): a long joining prompt stalls
+        in-flight decode by one bounded chunk, never one monolithic
+        prompt.  Returns True when a chunk (or a prefill-side
+        retirement) happened."""
+        while self._prefill_q:
+            slot, st = self._prefill_q[0]
+            if self._slots_state[slot] is not st or not st.prefilling:
+                self._prefill_q.popleft()  # slot retired/reassigned
+                continue
+            if st.stream.cancelled:
+                # cancel landed between chunks (or between the claim
+                # and the first chunk): free the slot AND its pages
+                # without burning another dispatch
+                self._prefill_q.popleft()
+                self._fail_slot(slot, st, GenerationCancelled(
+                    f"stream cancelled during prefill after "
+                    f"{st.chunks} chunk(s); KV slot {slot} and "
+                    f"{len(st.pages)} page(s) freed"), "cancelled")
+                return True
+            return self._run_chunk(slot, st)
+        return False
+
+    def _run_chunk(self, slot: int, st: _Slot) -> bool:
+        """Dispatch ONE prefill chunk for the queue-head slot; on the
+        final chunk, fetch the stream's first token (the one host sync
+        per join), activate the slot, and promote its full prompt
+        pages into the prefix cache."""
+        prompt = st.prompt
+        start = st.next_pos
+        remaining = int(prompt.size) - start
+        chunk = (remaining if self.prefill_chunk <= 0
+                 else min(self.prefill_chunk, remaining))
+        if not self._ensure_pages(slot, st, start + chunk):
+            self._prefill_q.popleft()
+            self._fail_slot(slot, st, KVCacheExhausted(
+                f"no KV page free for prefill at position {start} "
+                f"(pool {self.num_pages} pages, "
+                f"{self._pool.pages_in_use} in use, prefix cache "
+                f"fully referenced)"), "shed")
+            return True
+        bucket = self._decoder.prefill_bucket(chunk)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :chunk] = prompt[start:start + chunk]
+        fn = self._decoder.prefill_fn(bucket)
+        final = start + chunk >= int(prompt.size)
+        tok = 0
         try:
-            bucket = self._decoder.prefill_bucket(prompt.size)
-            tokens = np.zeros((1, bucket), np.int32)
-            tokens[0, :prompt.size] = prompt
-            fn = self._decoder.prefill_fn(bucket)
             with jax.profiler.StepTraceAnnotation(
                     "gen-prefill", step_num=self._n_steps):
                 first, self._caches = fn(
                     self.model._params, self._caches, tokens,
-                    np.int32(slot), np.int32(prompt.size))
-                # one fetch per JOIN (not per step): the stream's first
-                # token comes out of the prefill dispatch itself
-                tok = int(jax.device_get(first))
-        except BaseException as e:  # noqa: BLE001 — a poisoned prefill
+                    self._table[slot].copy(), np.int32(slot),
+                    np.int32(start), np.int32(chunk))
+                if final:
+                    # one fetch per JOIN (not per chunk): the stream's
+                    # first token comes out of the last chunk itself
+                    tok = int(jax.device_get(first))
+        except BaseException as e:  # noqa: BLE001 — a poisoned chunk
             # fails the joining stream AND (because the dispatch may
             # have consumed the donated cache pytree) every in-flight
             # stream; the engine re-arms and keeps serving the queue
-            if stream._fail(e):
+            self._prefill_q.popleft()
+            if st.stream._fail(e):
                 self.metrics.record_failure(e)
-                self._trace_terminal(stream, "error", self.clock())
+                self._trace_terminal(st.stream, "error", self.clock())
             self._recover_from_dispatch_error(e, "gen_prefill_error")
-            return
+            return True
+        st.next_pos = start + chunk
+        st.chunks += 1
+        self._chunks_total += 1
+        if not final:
+            return True  # next chunk at a later step boundary
+        self._prefill_q.popleft()
         now = self.clock()
-        st = _Slot(stream, tok, prompt.size)
-        self._slots_state[slot] = st
+        st.prefilling = False
+        st.length = int(prompt.size)
+        st.last_token = tok
+        st.generated = 1
+        stream = st.stream
         stream.ttft = now - stream.t_submit
         stream._emit(tok)
         self.metrics.record_ttft(stream.ttft)
         self.metrics.record_prefill_token()
-        if traced and stream.trace is not None:
+        if self._prefix is not None:
+            # promote the freshly-computed full prompt pages (the hit
+            # prefix re-touches its nodes' LRU stamps)
+            full = max(0, (int(prompt.size) - 1) // self.page_size)
+            self._prefix.insert(prompt, st.pages[:full])
+        if self._tracer.active and stream.trace is not None:
             tname = self.name or "generate"
             self._tracer.span("queue", stream.trace, stream.t_submit,
-                              t_join, tid=tname, slot=slot)
-            self._tracer.span("prefill", stream.trace, t_join, now,
-                              tid=tname, slot=slot, bucket=bucket,
-                              prompt_len=int(prompt.size))
+                              st.t_join, tid=tname, slot=slot)
+            self._tracer.span("prefill", stream.trace, st.t_join, now,
+                              tid=tname, slot=slot,
+                              prompt_len=int(prompt.size),
+                              prefix_hit_tokens=st.hit_tokens,
+                              prefill_chunks=st.chunks)
         self._retire(slot, st, now)
+        return True
 
+    # ---- page bookkeeping ----------------------------------------------
+    def _alloc_page(self) -> Optional[int]:
+        """One page from the pool, LRU-evicting unreferenced prefix
+        pages under pressure; None only when every page backs a live
+        slot (the caller sheds the stream)."""
+        pg = self._pool.alloc()
+        while pg is None and self._prefix is not None \
+                and self._prefix.evict(1):
+            pg = self._pool.alloc()
+        return pg
+
+    def _ensure_pages(self, slot: int, st: _Slot,
+                      upto_pos: int) -> bool:
+        """Grow the slot's page table to cover positions
+        ``[0, upto_pos)``.  The whole deficit is evicted in ONE trie
+        walk up front (PrefixCache.evict batches the LRU scan) — a
+        per-allocation evict_one loop would rescan the trie per page
+        under exactly the pool pressure that makes the trie large."""
+        need = (int(upto_pos) - 1) // self.page_size + 1
+        deficit = need - len(st.pages) - self._pool.pages_free
+        if deficit > 0 and self._prefix is not None:
+            self._prefix.evict(deficit)
+        while len(st.pages) < need:
+            pg = self._alloc_page()
+            if pg is None:
+                return False
+            self._table[slot, len(st.pages)] = pg
+            st.pages.append(pg)
+        return True
+
+    def _grow_active_pages(self) -> None:
+        """Before a decode dispatch: every active slot needs a page for
+        the position it is about to write.  A slot the pool cannot
+        serve (undersized ``serve_kv_pages`` with the prefix cache
+        fully referenced) is shed — only that stream fails."""
+        for i, s in enumerate(self._slots_state):
+            if s is None or s.prefilling:
+                continue
+            if not self._ensure_pages(i, s, s.length + 1):
+                self._fail_slot(i, s, KVCacheExhausted(
+                    f"no KV page free for decode at position "
+                    f"{s.length} (pool {self.num_pages} pages, "
+                    f"{self._pool.pages_in_use} in use)"), "shed")
+
+    def _release_slot(self, slot: int, st: _Slot) -> None:
+        """Return the slot's pages to the pool (shared prefix pages
+        just drop one reference — the trie keeps them cached) and
+        clear its table row back to the OOB sentinel."""
+        for pg in st.pages:
+            self._pool.release(pg)
+        st.pages = []
+        self._table[slot, :] = self._pool.no_page
+        self._slots_state[slot] = None
+
+    def _fail_slot(self, slot: int, st: _Slot, exc: BaseException,
+                   phase: str) -> None:
+        now = self.clock()
+        if st.stream._fail(exc):
+            self.metrics.record_failure(exc)
+            self._trace_terminal(st.stream, phase, now)
+        self._release_slot(slot, st)
+
+    # ---- decode --------------------------------------------------------
     def _decode_once(self) -> None:
         """Advance the whole decode batch one position: one dispatch,
-        one token fetch, scatter to streams."""
+        one token fetch, scatter to streams.  Write pages/rows are
+        host-computed — inactive and PREFILLING slots ride the pool's
+        OOB sentinel so their dummy writes drop instead of corrupting
+        a (possibly shared) page."""
         tokens = np.zeros((self.slots,), np.int32)
         pos = np.zeros((self.slots,), np.int32)
+        wp = np.full((self.slots,), self._pool.no_page, np.int32)
+        wr = np.zeros((self.slots,), np.int32)
         nactive = 0
         for i, s in enumerate(self._slots_state):
-            if s is not None:
+            if s is not None and not s.prefilling:
                 tokens[i] = s.last_token
                 pos[i] = s.length
+                wp[i] = self._table[i, s.length // self.page_size]
+                wr[i] = s.length % self.page_size
                 nactive += 1
         fn = self._decoder.decode_fn()
         # ONE lock-free tracing check per decode step (hot-path
@@ -811,14 +1108,15 @@ class GenerationEngine:
         with jax.profiler.StepTraceAnnotation("generate",
                                               step_num=self._n_steps):
             nxt, self._caches = fn(self.model._params, self._caches,
-                                   tokens, pos)
+                                   tokens, pos, self._table.copy(),
+                                   wp, wr)
             # THE one host sync per decode step for the whole batch —
             # per-stream tokens are scattered from it below (RL010)
             host = np.asarray(jax.device_get(nxt))
         now = self.clock()
         self._n_steps += 1
         for i, s in enumerate(self._slots_state):
-            if s is None:
+            if s is None or s.prefilling:
                 continue
             tok = int(host[i])
             s.length += 1
@@ -834,18 +1132,18 @@ class GenerationEngine:
         self._fire_cancel_at_token(now)
         if self.stats_every and self._n_steps % self.stats_every == 0:
             self.metrics.emit(extra={"slots": self.slots,
-                                     "active": nactive,
-                                     "kv_cache_bytes":
-                                         self.kv_cache_bytes})
+                                     "active": nactive})
 
     def _recover_from_dispatch_error(self, e: BaseException,
                                      event: str) -> None:
         """A failed prefill/decode dispatch raised AFTER the cache
-        pytree was donated: off-CPU the buffers are invalidated, so
-        every active stream's state is unrecoverable — fail them all,
-        reallocate the cache, and keep serving queued prompts (the
-        engine recovers; a poisoned dispatch must never wedge it on
-        'Array has been deleted' forever)."""
+        pytree was donated: off-CPU the pool buffers are invalidated,
+        so every active stream's state — and every cached prefix page
+        — is unrecoverable.  Fail them all, rebuild the pool + prefix
+        cache (lifetime counters carry over), reallocate the device
+        pools, and keep serving queued prompts (the engine recovers; a
+        poisoned dispatch must never wedge it on 'Array has been
+        deleted' forever)."""
         failed = 0
         now = self.clock()
         for i, s in enumerate(self._slots_state):
@@ -856,6 +1154,17 @@ class GenerationEngine:
                 self._trace_terminal(s.stream, "error", now)
                 failed += 1
             self._slots_state[i] = None
+        self._prefill_q.clear()
+        if self._prefix is not None:
+            self._evictions_base += self._prefix.evictions
+        self._pool_high_base = max(self._pool_high_base,
+                                   self._pool.high_water)
+        self._pool = KVPagePool(self.num_pages, self.page_size)
+        self._prefix = (PrefixCache(self._pool)
+                        if self.prefix_cache_enabled else None)
+        self._table = np.full((self.slots,
+                               self._decoder.pages_per_slot),
+                              self._pool.no_page, np.int32)
         self._caches = self._decoder.init_cache()
         get_logger("serve").event(  # RL011-ok: gen_decode_error |
             # gen_prefill_error, both declared in obs/events.py —
@@ -871,17 +1180,15 @@ class GenerationEngine:
                                   "failed_streams": failed})
 
     def _retire(self, slot: int, s: _Slot, now: float) -> None:
-        """Free the slot if its stream finished or was cancelled —
-        run at every step boundary, so a mid-generation cancel frees
-        KV capacity for the next queued prompt immediately."""
+        """Free the slot — and its pages — if its stream finished or
+        was cancelled; run at every step boundary, so a mid-generation
+        cancel frees KV capacity for the next queued prompt
+        immediately."""
         if s.stream.cancelled:
             exc = GenerationCancelled(
                 f"stream cancelled after {s.generated} token(s); "
-                f"KV slot {slot} freed")
-            if s.stream._fail(exc):
-                self.metrics.record_failure(exc)
-                self._trace_terminal(s.stream, "cancelled", now)
-            self._slots_state[slot] = None
+                f"KV slot {slot} and {len(s.pages)} page(s) freed")
+            self._fail_slot(slot, s, exc, "cancelled")
             return
         done = s.generated >= s.stream.max_new or (
             self.eos_id is not None and s.last_token == self.eos_id)
@@ -890,10 +1197,11 @@ class GenerationEngine:
                 self.metrics.record_request(now - s.stream.t_submit,
                                             deadlined=s.stream.deadlined)
                 self._trace_terminal(s.stream, "completed", now)
-            self._slots_state[slot] = None
+            self._release_slot(slot, s)
 
     def _abort_active(self) -> None:
-        """drain(timeout) expired: shed whatever is still decoding."""
+        """drain(timeout) expired: shed whatever is still decoding or
+        prefilling (pages go back to the pool with the slots)."""
         now = self.clock()
         for i, s in enumerate(self._slots_state):
             if s is None:
@@ -903,7 +1211,8 @@ class GenerationEngine:
             if s.stream._fail(exc):
                 self.metrics.record_failure(exc)
                 self._trace_terminal(s.stream, "shed", now)
-            self._slots_state[i] = None
+            self._release_slot(i, s)
+        self._prefill_q.clear()
 
     # ---- fault injection (FF_FAULT generation kinds) -------------------
     def _fire_slow_decode(self) -> None:
@@ -917,7 +1226,8 @@ class GenerationEngine:
             if st["kind"] != "serve_cancel_at_token" or st["fired"]:
                 continue
             for i, s in enumerate(self._slots_state):
-                if s is not None and s.generated >= st["n"]:
+                if s is not None and not s.prefilling \
+                        and s.generated >= st["n"]:
                     st["fired"] = 1
                     get_logger("serve").event(
                         "gen_fault_cancel", model=self.name, slot=i,
@@ -934,8 +1244,9 @@ class GenerationEngine:
         strategy ``.pb``: load the per-op ParallelConfigs, compile the
         model against them (ffcheck-verified, mesh inferred from the
         strategy when not given), place/re-place every parameter under
-        its strategy PartitionSpec, and shard the KV cache heads over
-        the ``c`` axis — one checkpoint, any searched sharding.
+        its strategy PartitionSpec, and shard the KV page pools' head
+        dim over the ``c`` axis — one checkpoint, any searched
+        sharding.
 
         Accepts a fresh (uncompiled) model — compiled+initialized here
         — or an already-initialized one, whose live params are gathered
@@ -965,8 +1276,8 @@ class GenerationEngine:
             # re-place live params under the strategy's shardings (the
             # partition-rule -> PartitionSpec pytree pattern); the AOT
             # forward cache lowered for the old placement must drop —
-            # and so must any cached GraphDecoders, whose KV-cache
-            # layout was derived from the OLD mesh
+            # and so must any cached GraphDecoders, whose pool layout
+            # was derived from the OLD mesh
             for p in model.parameters:
                 if p.name in model._params:
                     val = model._gather_host(model._params[p.name])

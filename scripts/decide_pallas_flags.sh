@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# One-shot on-chip Pallas flag decision (ROADMAP "on-chip microbench
+# run to flip pallas_norm per device kind"): run the isolated kernel
+# microbench (scripts/kernel_microbench.py — ~2 min of chip time), then
+# decide the pallas_pool/pallas_norm tuned gates from the measured
+# rows (scripts/decide_pallas_pool.py), writing
+#
+#   flexflow_tpu/tuned_defaults.json        (the runtime gate table)
+#   artifacts/pallas_flags_<kind>.json      (the decision artifact,
+#                                            schema-gated by
+#                                            scripts/check_gen_artifacts.py)
+#   artifacts/r5/microbench_<ts>.log        (the evidence rows)
+#
+# Run ON the target device kind (queue through scripts/chip_queue.txt
+# for TPU windows); FF_MB_FORCE_CPU=1 exercises the plumbing on CPU
+# (the verdict then keys on the CPU device kind — smoke only).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+mkdir -p artifacts/r5
+log="artifacts/r5/microbench_$(date +%Y%m%d_%H%M%S).log"
+echo "== kernel microbench -> $log =="
+python scripts/kernel_microbench.py 2>&1 | tee "$log"
+
+echo "== deciding pallas flags from the measured rows =="
+python scripts/decide_pallas_pool.py
+
+echo "== schema-checking the decision artifact =="
+python scripts/check_gen_artifacts.py --pallas-only

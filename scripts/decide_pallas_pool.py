@@ -9,6 +9,12 @@ Reads the newest ``microbench_pallas_pool_bwd_stem`` and
 of ``flexflow_tpu/tuned_defaults.json`` for this device kind: ON iff
 the measured stock/fast speedup clears 1.05 (5% margin — a tie keeps
 stock, which fuses with neighbors and has no Mosaic compile risk).
+
+Also emits ``artifacts/pallas_flags_<kind>.json`` — the per-device-kind
+DECISION ARTIFACT (``scripts/decide_pallas_flags.sh`` is the one-shot
+driver: microbench then decide).  Schema-gated by
+``scripts/check_gen_artifacts.py`` in the repo static gate, so a
+committed decision can never rot silently.
 """
 
 import glob
@@ -68,6 +74,14 @@ def main():
     except (OSError, ValueError):
         table = {}
     pool_on = None
+    decision = {
+        "schema_version": 1,
+        "artifact": "pallas-flags-decision",
+        "device_kind": kind,
+        "margin": MARGIN,
+        "decided_utc": time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime()),
+        "flags": {},
+    }
     for flag, row in rows.items():
         if row is None:
             print(f"no {flag} microbench row; leaving its default")
@@ -87,10 +101,27 @@ def main():
                                          time.gmtime()),
             "row": row,
         }
+        decision["flags"][flag] = {
+            "on": bool(on),
+            "speedup": (None if row.get("value") is None
+                        else float(row["value"])),
+            "row": row,
+        }
         print(f"tuned_defaults[{flag}][{kind}] = {on}")
     with open(OUT, "w") as f:
         json.dump(table, f, indent=2, sort_keys=True)
         f.write("\n")
+    if decision["flags"]:
+        # the per-device-kind decision artifact (checked by
+        # scripts/check_gen_artifacts.py); kind strings like
+        # "TPU v5 lite" sanitize to a filename token
+        safe = "".join(c if c.isalnum() else "_" for c in kind).lower()
+        dpath = os.path.join(os.path.dirname(__file__), "..",
+                             "artifacts", f"pallas_flags_{safe}.json")
+        with open(dpath, "w") as f:
+            json.dump(decision, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {os.path.normpath(dpath)}")
     if pool_on is not None:
         # verdict marker for the queue gate (run_if_pallas.sh) — carries
         # the ACTUAL device kind so the gate never hardcodes one

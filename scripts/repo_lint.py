@@ -96,6 +96,16 @@ by tier-1 ``tests/test_static_checks.py``).  Rules:
   (``jnp.float32`` for pinned f32 accumulation/statistics) are the
   sanctioned spelling of a *semantic* pin and stay legal; the rare
   legitimate string/call site carries an ``RL012-ok:`` comment.
+* **RL013 — KV pages are allocated ONLY through the page-pool module**
+  (ISSUE 15): inside ``flexflow_tpu/serving/generation/`` (except
+  ``pages.py`` — the sanctioned allocation site), a
+  ``jnp.zeros``/``np.zeros``/``ones``/``empty``/``full`` call whose
+  shape literal has >= 3 dims is a KV-shaped allocation bypassing
+  ``pages.alloc_pool_arrays`` — a second allocation path whose bytes
+  the ``analysis.kv_memory`` page-pool accounting (and therefore the
+  FF108/FF121/FF130 gates) would never see.  1-D/2-D staging buffers
+  (token rows, page tables) stay legal; the rare legitimate site
+  carries an ``RL013-ok:`` comment.
 * **RL011 — every emitted event name is declared in the registry**
   (ISSUE 13): a ``Category.event("name", ...)`` call site in
   ``flexflow_tpu/`` must pass a string literal declared in
@@ -219,6 +229,12 @@ _RL007_EXEMPT = ("flexflow_tpu/search/cost_model.py",
 # bandwidths are 1e9-1e12, MXU flops ~1e14; sentinels like 1e29 and
 # epsilons are far outside)
 _RL007_LO, _RL007_HI = 1e8, 1e16
+
+
+# RL013: the one sanctioned KV allocation site under serving/generation/
+_RL013_POOL_MODULE = "flexflow_tpu/serving/generation/pages.py"
+_RL013_ALLOC_LEAVES = {"zeros", "ones", "empty", "full"}
+_RL013_ALLOC_ROOTS = {"jnp", "np", "numpy", "jax.numpy"}
 
 
 # `# guarded_by: self._cv` (field or def-line) / `# unguarded-ok: why`
@@ -381,8 +397,39 @@ class _Visitor(ast.NodeVisitor):
             self._check_raw_mesh(node, name)
             self._check_clock(node, name)
             self._check_dtype_call(node, name)
+            self._check_kv_alloc(node, name)
         self._check_event_name(node)
         self.generic_visit(node)
+
+    def _check_kv_alloc(self, node: ast.Call, name: str) -> None:
+        """RL013: KV-shaped (rank >= 3) array allocations under
+        serving/generation/ happen in pages.py ONLY — a second
+        allocation site cannot be seen by the kv_memory page-pool
+        accounting the FF108/FF121/FF130 gates charge."""
+        if (not self.in_generation
+                or self.relpath == _RL013_POOL_MODULE):
+            return
+        root, _, leaf = name.rpartition(".")
+        if leaf not in _RL013_ALLOC_LEAVES \
+                or root not in _RL013_ALLOC_ROOTS:
+            return
+        if not node.args:
+            return
+        shape = node.args[0]
+        if not isinstance(shape, (ast.Tuple, ast.List)) \
+                or len(shape.elts) < 3:
+            return  # 1-D/2-D staging buffers (token rows, page tables)
+        line = (self.lines[node.lineno - 1]
+                if 0 < node.lineno <= len(self.lines) else "")
+        if "RL013-ok" not in line:
+            self._add(node, "RL013",
+                      f"{name}() with a rank-{len(shape.elts)} shape in "
+                      f"serving/generation/ — KV pages are allocated "
+                      f"only through pages.alloc_pool_arrays (the "
+                      f"analysis.kv_memory-accounted pool); a raw "
+                      f"KV-shaped buffer here is HBM the FF108/FF121/"
+                      f"FF130 gates never see.  Annotate 'RL013-ok: "
+                      f"why' if this site is legitimate")
 
     def _check_dtype_call(self, node: ast.Call, name: str) -> None:
         """RL012 (call half): jnp.dtype()/np.dtype() in op modules is a

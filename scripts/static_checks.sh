@@ -55,6 +55,13 @@ python scripts/check_strategy_artifacts.py || rc=1
 echo "== fleet artifacts (registry + bench schema) =="
 python scripts/check_fleet_artifacts.py || rc=1
 
+# the paged-KV/prefix-cache bench artifact must keep its acceptance
+# booleans (TTFT win, stall win, HBM high-water, bit-identical parity,
+# reconciliation) AND any committed per-device-kind Pallas decision
+# artifacts must parse (docs/serving.md "Paged KV & prefix caching")
+echo "== generation/pallas artifacts (prefix bench + flag decisions) =="
+python scripts/check_gen_artifacts.py || rc=1
+
 # committed trace exports + Prometheus exposition snapshots must keep
 # validating against the CURRENT schemas/exporter — an observability
 # format change can never rot silently (docs/observability.md)

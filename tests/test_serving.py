@@ -118,6 +118,53 @@ def test_deadline_flush_fake_clock():
     assert b.poll() is None          # queue drained
 
 
+def test_reap_expired_no_deadline_skips_scan(monkeypatch):
+    """ISSUE 15 satellite pin: reap_expired() runs at EVERY generation
+    decode-step boundary, and with nothing deadline/stale-bearing
+    queued (the live ``_watch`` count is zero) it must return without
+    entering the queue scan or even reading the clock — the O(1) fast
+    path.  A deadline-bearing submit flips the count and the scan
+    engages again."""
+    clk = FakeClock()
+    b = MicroBatcher(max_batch=8, max_wait_ms=5.0, clock=clk)
+    done = []
+    for _ in range(3):
+        b.submit(_req(1, clk, done))
+    entered = []
+    orig_scan = b._collect_expired
+
+    def spy(now):
+        entered.append(now)
+        return orig_scan(now)
+
+    monkeypatch.setattr(b, "_collect_expired", spy)
+    reads = []
+    real = clk
+
+    def counting_clock():
+        reads.append(1)
+        return real()
+
+    monkeypatch.setattr(b, "clock", counting_clock)
+    n_reads = len(reads)
+    assert b.reap_expired() == 0
+    assert entered == [], "scan path entered with no watched request"
+    assert len(reads) == n_reads, "clock read on the O(1) path"
+    # a deadline-bearing request flips _watch: the scan engages, and
+    # the expiry fires through the (spied) scan path
+    b.submit(Request((np.zeros((1, 1), np.float32),), 1,
+                     lambda out, now: done.append(("dl", out)),
+                     clk.t, deadline=clk.t + 5.0))
+    assert b.reap_expired() == 0 and len(entered) == 1  # scan, no expiry
+    clk.t += 10.0
+    assert b.reap_expired() == 1 and len(entered) == 2
+    assert isinstance(done[-1][1], DeadlineExceeded)
+    # the expired request left the queue; _watch is back to zero and
+    # the fast path re-engages
+    n_scans = len(entered)
+    assert b.reap_expired() == 0 and len(entered) == n_scans
+
+
 def test_full_batch_flushes_without_deadline():
     clk = FakeClock()
     b = MicroBatcher(max_batch=8, max_wait_ms=1e9, clock=clk)

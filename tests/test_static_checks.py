@@ -415,6 +415,37 @@ def test_static_checks_script_passes_on_repo():
      "from flexflow_tpu.fflogger import get_logger\n\ndef f():\n"
      "    get_logger('serve').event('totally_adhoc', x=1)\n",
      None),
+    # RL013: a KV-shaped (rank >= 3) allocation in serving/generation/
+    # outside pages.py bypasses the page pool the kv_memory accounting
+    # (and the FF108/FF121/FF130 gates) integrate (ISSUE 15)
+    ("flexflow_tpu/serving/generation/zz_bad_kv_alloc.py",
+     "import jax.numpy as jnp\n\ndef f(pages, P, h, hd):\n"
+     "    return jnp.zeros((pages, P, h, hd), jnp.float32)\n",
+     "RL013"),
+    ("flexflow_tpu/serving/generation/zz_bad_kv_alloc_np.py",
+     "import numpy as np\n\ndef f(slots, seq, d):\n"
+     "    return np.zeros((slots, seq, d), np.float32)\n",
+     "RL013"),
+    # pages.py IS the pool module — exempt
+    ("flexflow_tpu/serving/generation/pages.py",
+     "import jax.numpy as jnp\n\ndef alloc(shape):\n"
+     "    return jnp.zeros((4, 16, 2, 16), jnp.float32)\n",
+     None),
+    # 1-D/2-D staging buffers (token rows, page tables) stay legal
+    ("flexflow_tpu/serving/generation/zz_ok_staging.py",
+     "import numpy as np\n\ndef f(slots, tpp):\n"
+     "    return np.zeros((slots, tpp), np.int32)\n",
+     None),
+    # the waiver comment admits the rare legitimate site
+    ("flexflow_tpu/serving/generation/zz_ok_waived_kv.py",
+     "import numpy as np\n\ndef f():\n"
+     "    return np.zeros((2, 2, 2))  # RL013-ok: host-side test rig\n",
+     None),
+    # outside serving/generation/ the rule does not engage
+    ("flexflow_tpu/serving/zz_ok_dense_alloc.py",
+     "import numpy as np\n\ndef f(n, s, d):\n"
+     "    return np.zeros((n, s, d), np.float32)\n",
+     None),
     # RL012: jnp.dtype() resolution in an op module bypasses the ONE
     # precision-resolution point (ops/common.py)
     ("flexflow_tpu/ops/zz_bad_dtype_call.py",
