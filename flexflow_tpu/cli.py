@@ -87,8 +87,8 @@ def main(argv=None) -> None:
               "<script.py> [script args]\n"
               "       flexflow-tpu search-bench [flags]\n"
               "       flexflow-tpu train-bench [flags]\n"
-              "       flexflow-tpu serve-bench [--overload|--generate|"
-              "--fleet] [flags]\n"
+              "       flexflow-tpu serve-bench [--overload|--generate"
+              " [--prefix|--speculate]|--fleet] [flags]\n"
               "       flexflow-tpu precision-bench [--out f.json]\n"
               "       flexflow-tpu calibrate [--out table.json | "
               "--check FILE...]\n"

@@ -366,6 +366,20 @@ class FFConfig:
     # (Sarathi-style).  0 = whole-prompt chunks (the monolithic
     # baseline serve-bench --generate compares against).
     serve_prefill_chunk: int = 0
+    # Speculative decoding (docs/serving.md "Speculative decoding &
+    # sampling").  serve_spec_gamma: draft tokens proposed per round
+    # when a draft model is attached — 0 = off, else >= 2 (a 1-row
+    # verify window lowers matrix-vector kernels whose bits drift,
+    # same floor as serve_gen_slots/serve_buckets).  Only consulted
+    # when the engine is given a draft model.
+    serve_spec_gamma: int = 0
+    # serve_spec_gamma_max: ceiling for the adaptive controller's γ
+    # candidates (and a sanity bound for the fixed policy).
+    serve_spec_gamma_max: int = 4
+    # serve_spec_policy: "fixed" runs serve_spec_gamma every round;
+    # "adaptive" prices candidate γs from the live accept-rate EWMA
+    # against their calibrated round cost and retunes periodically.
+    serve_spec_policy: str = "fixed"
     # Sparse embedding-table updates (reference parity: the embedding
     # backward scatter-accumulates only the touched rows,
     # embedding.cu:192-228 — it never streams the full table).  A dense
@@ -407,6 +421,18 @@ class FFConfig:
                 f"FFConfig.serve_kv_pages/serve_prefill_chunk must be "
                 f">= 0 (0 = auto/monolithic), got "
                 f"{self.serve_kv_pages}/{self.serve_prefill_chunk}")
+        if self.serve_spec_gamma != 0 and self.serve_spec_gamma < 2:
+            raise ValueError(
+                f"FFConfig.serve_spec_gamma must be 0 (off) or >= 2, "
+                f"got {self.serve_spec_gamma}")
+        if self.serve_spec_gamma_max < 2:
+            raise ValueError(
+                f"FFConfig.serve_spec_gamma_max must be >= 2, got "
+                f"{self.serve_spec_gamma_max}")
+        if self.serve_spec_policy not in ("fixed", "adaptive"):
+            raise ValueError(
+                f"FFConfig.serve_spec_policy must be 'fixed' or "
+                f"'adaptive', got {self.serve_spec_policy!r}")
 
     @property
     def num_devices(self) -> int:
@@ -547,6 +573,16 @@ class FFConfig:
                         f"got {cfg.serve_prefix_cache!r}")
             elif a == "--serve-prefill-chunk":
                 cfg.serve_prefill_chunk = int(val())
+            elif a == "--serve-spec-gamma":
+                cfg.serve_spec_gamma = int(val())
+            elif a == "--serve-spec-gamma-max":
+                cfg.serve_spec_gamma_max = int(val())
+            elif a == "--serve-spec-policy":
+                cfg.serve_spec_policy = val().lower()
+                if cfg.serve_spec_policy not in ("fixed", "adaptive"):
+                    raise ValueError(
+                        f"--serve-spec-policy must be 'fixed' or "
+                        f"'adaptive', got {cfg.serve_spec_policy!r}")
             elif a == "--trace-sample-rate":
                 cfg.trace_sample_rate = float(val())
             elif a == "--metrics-port":

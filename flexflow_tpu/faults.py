@@ -62,6 +62,10 @@ Grammar (specs joined by ``;``, qualifiers by ``,``)::
     serve_slow_decode:N     the first N decode steps each stall ``ms``
                             milliseconds (default 50) through the
                             engine's injectable sleep
+    spec_draft_fail:N       the Nth speculative DRAFT dispatch raises
+                            (fires once) — the engine must demote to
+                            plain decode (serve_health fallback event)
+                            with NO stream failing
 
     model-fleet kinds (consumed by the FleetEngine — :func:`
     fleet_faults`; docs/serving.md "Model fleets"):
@@ -120,7 +124,7 @@ KINDS = ("kill_at_step", "hang_at_step", "corrupt_ckpt",
          "spawn_fail_attempt", "slow_rank", "grow_at_step",
          "shrink_at_step", "serve_slow_dispatch", "serve_fail_dispatch",
          "serve_queue_spike", "serve_cancel_at_token",
-         "serve_slow_decode", "fleet_load_fail",
+         "serve_slow_decode", "spec_draft_fail", "fleet_load_fail",
          "fleet_swap_at_dispatch")
 
 SERVE_KINDS = ("serve_slow_dispatch", "serve_fail_dispatch",
@@ -129,7 +133,8 @@ SERVE_KINDS = ("serve_slow_dispatch", "serve_fail_dispatch",
 # token-generation kinds (GenerationEngine's decode loop —
 # docs/serving.md "Token generation"); disjoint from SERVE_KINDS so a
 # plan mixing both drives each engine's own fire points only
-GENERATION_KINDS = ("serve_cancel_at_token", "serve_slow_decode")
+GENERATION_KINDS = ("serve_cancel_at_token", "serve_slow_decode",
+                    "spec_draft_fail")
 
 # model-fleet kinds (FleetEngine / fleet registry — docs/serving.md
 # "Model fleets"); disjoint from both sets above

@@ -150,6 +150,33 @@ def _paged_chunk_attention(q, kg, vg, qpos, scale: float):
                       preferred_element_type=jnp.float32)
 
 
+def _verify_window_attention(q, kg, vg, qpos, scale: float):
+    """Speculative-verify attention: a W-position window PER SLOT
+    against each slot's gathered page view (docs/serving.md
+    "Speculative decoding & sampling").  ``q``: (n, W, h, d) — slot i's
+    queries at GLOBAL positions ``qpos[i] .. qpos[i]+W-1``;
+    ``kg``/``vg``: (n, L, h, d) — each slot's page table gathered back
+    into position order; ``qpos``: (n, W) int32 global positions.
+
+    This is :func:`_paged_chunk_attention` batched over slots — the
+    identical einsum/mask/softmax arithmetic with the causal mask keyed
+    on per-slot global positions, so window row t is bit-identical on
+    CPU to the sequential decode step at that position given the same
+    cache content (the greedy-speculation parity pin's kernel half).
+    Columns beyond a row's position — including the window's own
+    not-yet-verified later rows and any stale speculated rows from a
+    rolled-back round — contribute exact zeros, never values; rollback
+    is free because visibility is the mask, not the write."""
+    scores = jnp.einsum("nqhd,nkhd->nhqk", q, kg,
+                        preferred_element_type=jnp.float32) * scale
+    kpos = jnp.arange(kg.shape[1])
+    scores = jnp.where(kpos[None, None, None, :]
+                       > qpos[:, None, :, None], NEG_INF, scores)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("nhqk,nkhd->nqhd", probs.astype(vg.dtype), vg,
+                      preferred_element_type=jnp.float32)
+
+
 def _dense_attention(q, k, v, causal: bool, scale: float,
                      dropout_rate: float, rng):
     """(n,sq,h,d),(n,sk,h,d),(n,sk,h,d) -> (n,sq,h,d); f32 softmax."""
@@ -460,6 +487,44 @@ class MultiHeadAttention(Op):
         return ([self._out_proj(params, attn, n, 1, ctx)],
                 k_pool, v_pool)
 
+    def verify_paged(self, params, x, k_pool, v_pool, table, pos,
+                     write_pages, write_rows, ctx: OpContext):
+        """Speculative-verify step against the paged KV cache: project
+        a W-token window per slot, scatter its K/V rows into each
+        slot's pages at ``(write_pages[i, t], write_rows[i, t])``
+        (host-computed; the pool's ``no_page`` sentinel drops inactive
+        slots' writes), gather each slot's page table and attend every
+        window row over it, causally masked on GLOBAL positions.
+
+        ``x``: (slots, W, d) hidden states at positions ``pos[i] ..
+        pos[i]+W-1``; ``table``: (slots, pages_per_slot) int32;
+        ``pos``: (slots,) int32 first window position.  The chunked-
+        prefill generalization of :meth:`decode_paged` — same
+        :meth:`_qkv`/:meth:`_out_proj`, same gather, with
+        :func:`_verify_window_attention` (a slot-batched
+        :func:`_paged_chunk_attention`) as the kernel, so each window
+        row is bit-identical on CPU to the sequential decode step at
+        that position (the greedy-speculation parity pin).  Rejected
+        rows need no cleanup: they stay masked until a later round
+        overwrites them."""
+        n, w, _ = x.shape
+        xq = cast_compute(x, ctx)
+        q, k, v = self._qkv(params, xq, xq, xq, ctx)
+        k_pool = k_pool.at[write_pages, write_rows].set(k, mode="drop")
+        v_pool = v_pool.at[write_pages, write_rows].set(v, mode="drop")
+        h, hd = self.num_heads, self.head_dim
+        # mode="clip": sentinel table entries are OOB by design (the
+        # default "fill" would gather NaN that poisons the masked sum)
+        kg = jnp.take(k_pool, table, axis=0,
+                      mode="clip").reshape(n, -1, h, hd)
+        vg = jnp.take(v_pool, table, axis=0,
+                      mode="clip").reshape(n, -1, h, hd)
+        qpos = pos[:, None] + jnp.arange(w)[None, :]
+        attn = _verify_window_attention(q, kg, vg, qpos,
+                                        1.0 / math.sqrt(self.head_dim))
+        return ([self._out_proj(params, attn, n, w, ctx)],
+                k_pool, v_pool)
+
     def decode(self, params, x, k_cache, v_cache, pos, ctx: OpContext):
         """One decode step: project the current token, write its K/V
         into the per-slot cache at ``pos``, attend over the cache.
@@ -566,6 +631,16 @@ class PositionEmbedding(Op):
         that position."""
         rows = jnp.take(params[self.w_table.name], pos, axis=0)
         return [x + cast_compute(rows, ctx)[:, None, :]]
+
+    def decode_window(self, params, x, pos, ctx: OpContext):
+        """W-position lookup for the speculative-verify path: ``x``
+        (slots, W, d) holds each slot's window at GLOBAL positions
+        ``pos[i] .. pos[i]+W-1`` — gathers those table rows per slot.
+        Row for row the same values :meth:`decode` adds one position at
+        a time."""
+        qpos = pos[:, None] + jnp.arange(x.shape[1])[None, :]
+        rows = jnp.take(params[self.w_table.name], qpos, axis=0)
+        return [x + cast_compute(rows, ctx)]
 
     def forward_at(self, params, x, start, ctx: OpContext):
         """Offset lookup for chunked prefill: ``x`` (1, B, d) holds

@@ -111,13 +111,8 @@ class _Tenant:
         # tenant would be starved forever instead of paced
         return self.qps_rows <= 0 or self.allowance > 0.0
 
-    def resident_bytes(self) -> float:
-        """The tenant's REAL always-resident per-device bytes: the
-        device-0 shard bytes of every parameter, plus the generation
-        engine's preallocated KV cache.  This is the number the static
-        co-residency gate predicts byte-for-byte
-        (fleet/gate.model_residency, pinned in tests/test_fleet.py)."""
-        model = self.engine.model
+    @staticmethod
+    def _dev0_param_bytes(model) -> int:
         total = 0
         dev0 = None
         for arr in model._params.values():
@@ -131,8 +126,23 @@ class _Tenant:
             for s in shards:
                 if s.device == dev0:
                     total += s.data.nbytes
+        return total
+
+    def resident_bytes(self) -> float:
+        """The tenant's REAL always-resident per-device bytes: the
+        device-0 shard bytes of every parameter, plus the generation
+        engine's preallocated KV cache — and, under speculative
+        decoding, the co-hosted draft model's params + its own KV page
+        pool.  This is the number the static co-residency gate
+        predicts byte-for-byte (fleet/gate.model_residency, pinned in
+        tests/test_fleet.py)."""
+        total = self._dev0_param_bytes(self.engine.model)
         if self.kind == "generation":
             total += self.engine.kv_cache_bytes
+            draft = getattr(self.engine, "draft_model", None)
+            if draft is not None:
+                total += self._dev0_param_bytes(draft)
+                total += self.engine.draft_kv_cache_bytes
         return float(total)
 
 
@@ -255,6 +265,11 @@ class FleetEngine:
             return self
         if self.registry is not None:
             for name in self.registry.names():
+                if self.registry.spec(name).engine == "draft":
+                    # draft entries are built BY the generation tenant
+                    # that references them (inside its engine), never
+                    # started as standalone tenants
+                    continue
                 if name not in self._tenants:  # unguarded-ok: pre-thread
                     t = self._build_tenant(self.registry.spec(name))
                     with self._lock:
@@ -329,6 +344,14 @@ class FleetEngine:
     def _make_tenant(self, spec: TenantSpec, model) -> _Tenant:
         if spec.engine == "generation":
             gkw = dict(spec.generation)
+            draft_name = str(gkw.pop("draft", ""))
+            if draft_name:
+                # the draft tenant compiles + initializes HERE, on the
+                # same mesh — its params and draft KV pool live inside
+                # this tenant's engine, which is exactly what the gate
+                # charged onto this tenant's residency row
+                gkw["draft_model"] = build_model(
+                    self.registry.spec(draft_name), mesh=self.mesh)
             engine = GenerationEngine(
                 model, name=spec.name, clock=self.clock,
                 sleep=self._sleep, **gkw)

@@ -81,14 +81,21 @@ def model_residency(spec: TenantSpec, layers, input_tensors, strategies,
                     device_spec=None,
                     xla_temp_factor: Optional[float] = None,
                     compute_dtype: str = "float32",
-                    model_config=None) -> Dict:
+                    model_config=None, draft=None) -> Dict:
     """One tenant's per-device memory prediction (see module
     docstring).  ``mesh_shape`` defaults to the strategy-inferred mesh
     (exactly like ``lint``).  ``model_config`` (the built tenant's
     FFConfig) supplies the SAME fallbacks the GenerationEngine resolves
     — page geometry (``serve_kv_page``/``serve_kv_pages``) and the
     compute dtype — so a knob set in the builder's config rather than
-    the fleet spec still reaches the gate's accounting."""
+    the fleet spec still reaches the gate's accounting.
+
+    ``draft`` — ``(draft_name, draft_layers, draft_strategies)`` when
+    the tenant's generation section references a speculative-decoding
+    draft entry: the draft's params PLUS its own KV page pool (SAME
+    slots/seq/page geometry/dtype as the target — the engine mirrors
+    positions 1:1) are charged onto this tenant's residency, because
+    that is exactly what its GenerationEngine allocates."""
     from ...search.cost_model import XLA_TEMP_FACTOR, spec_for_device
     from ...search.simulator import Simulator
 
@@ -144,6 +151,18 @@ def model_residency(spec: TenantSpec, layers, input_tensors, strategies,
         quant_delta = quantized_params_bytes_delta(layers, strategies,
                                                    mesh)
         params += quant_delta
+    draft_name = ""
+    draft_bytes = 0.0
+    if draft is not None:
+        draft_name, draft_layers, draft_strategies = draft
+        draft_bytes = static_params_bytes(draft_layers,
+                                          draft_strategies, mesh)
+        if slots > 0 and seq > 0:
+            draft_bytes += kv_cache_bytes(
+                draft_layers, mesh_shape, slots, seq,
+                kv_dtype_bytes=dtype_bytes(compute_dtype),
+                page_size=kv_page or DEFAULT_PAGE_SIZE,
+                num_pages=kv_pages)
     return {
         "name": spec.name,
         "engine": spec.engine,
@@ -154,14 +173,17 @@ def model_residency(spec: TenantSpec, layers, input_tensors, strategies,
         "kv_bytes": kv,
         "kv_slots": slots,
         "kv_seq": seq,
+        "draft": draft_name,
+        "draft_bytes": draft_bytes,
         # the byte-for-byte pin vs the engine's real allocation
-        "resident_bytes": params + kv,
+        "resident_bytes": params + kv + draft_bytes,
         # the gate quantity: FF108 accounting + the unscaled KV scalar
         # (a preallocated buffer has no XLA temps — same rule as the
         # single-model lint --serve-slots path).  The quantization
         # delta rides UNSCALED too, like the KV cache: an int8 buffer
-        # swap has no XLA-temp component.
-        "ff108_bytes": peak + kv + quant_delta,
+        # swap has no XLA-temp component.  The draft's params + pool
+        # are preallocated residency of the SAME kind.
+        "ff108_bytes": peak + kv + quant_delta + draft_bytes,
     }
 
 
@@ -194,21 +216,35 @@ def fleet_gate_report(registry: ModelRegistry,
     total = 0.0
     for name in registry.names():
         spec = registry.spec(name)
+        if spec.engine == "draft":
+            # draft entries are charged onto the tenant that references
+            # them (exactly where their params + pool live at runtime),
+            # never as standalone rows — a double count would fail
+            # fleets that actually fit
+            continue
         model, strategies = registry.graph(name)
+        draft = None
+        dname = str(spec.generation.get("draft", ""))
+        if dname:
+            dmodel, dstrat = registry.graph(dname)
+            draft = (dname, dmodel.layers, dstrat)
         row = model_residency(spec, model.layers, model.input_tensors,
                               strategies, device_spec=device_spec,
                               xla_temp_factor=xla_temp_factor,
-                              model_config=model.config)
+                              model_config=model.config, draft=draft)
         rows.append(row)
         total += row["ff108_bytes"]
         kv_note = (f" + {row['kv_bytes'] / 1e9:.2f} GB KV "
                    f"({row['kv_slots']} slots x {row['kv_seq']})"
                    if row["kv_bytes"] else "")
+        draft_note = (f" + {row['draft_bytes'] / 1e9:.2f} GB draft "
+                      f"({row['draft']})" if row["draft_bytes"] else "")
         report.add(make(
             "FF131", name,
             f"[{row['engine']}] mesh {row['mesh']}: "
             f"{row['ff108_bytes'] / 1e9:.2f} GB peak "
-            f"({row['params_bytes'] / 1e9:.2f} GB params{kv_note})"))
+            f"({row['params_bytes'] / 1e9:.2f} GB params{kv_note}"
+            f"{draft_note})"))
     if total > hbm:
         worst = max(rows, key=lambda r: r["ff108_bytes"])
         report.add(make(

@@ -40,7 +40,11 @@ import dataclasses
 import json
 from typing import Callable, Dict, List, Optional
 
-ENGINE_KINDS = ("dense", "generation")
+# "draft" tenants are graphs co-hosted ONLY as a generation tenant's
+# speculative-decoding draft (referenced via generation.draft): the
+# fleet builds their params but never starts an engine for them — the
+# referencing tenant's GenerationEngine drives the draft directly
+ENGINE_KINDS = ("dense", "generation", "draft")
 
 # knobs a fleet entry may override per engine kind; validated here so a
 # typo'd knob fails at load, not as an ignored key
@@ -53,7 +57,11 @@ _GEN_KEYS = frozenset((
     # paged KV knobs (ISSUE 15): the co-residency gate reads the SAME
     # keys (serving/fleet/gate.py), so a tenant's page geometry and its
     # FF130 accounting cannot diverge
-    "page_size", "num_pages", "prefill_chunk", "prefix_cache"))
+    "page_size", "num_pages", "prefill_chunk", "prefix_cache",
+    # speculative decoding (ISSUE 16): "draft" names a co-registered
+    # engine="draft" entry; the gate charges its params + draft KV pool
+    # against the same hbm_gb budget (FF130)
+    "draft", "spec_gamma", "spec_gamma_max", "spec_policy"))
 
 
 @dataclasses.dataclass
@@ -110,6 +118,12 @@ class TenantSpec:
             raise ValueError(
                 f"tenant {self.name!r}: qps_rows must be >= 0 "
                 f"(0 = unlimited), got {self.qps_rows}")
+        if self.engine == "draft" and (self.serve or self.generation):
+            raise ValueError(
+                f"tenant {self.name!r}: draft entries serve no traffic "
+                f"of their own — no serve{{}}/generation{{}} sections "
+                f"(the referencing tenant's generation section carries "
+                f"the speculation knobs)")
 
 
 def builtin_builders() -> Dict[str, Callable]:
@@ -138,6 +152,10 @@ def validate_fleet_json(obj) -> List[str]:
         return ["'fleet' must be a non-empty list of tenant entries"]
     if "hbm_gb" in obj and not isinstance(obj["hbm_gb"], (int, float)):
         probs.append("hbm_gb: want a number")
+    # name -> engine kind pre-pass: generation.draft references another
+    # entry IN THIS FILE, so the check needs the whole fleet first
+    kinds = {e.get("name"): e.get("engine", "dense")
+             for e in fleet if isinstance(e, dict)}
     seen = set()
     for i, e in enumerate(fleet):
         where = f"fleet[{i}]"
@@ -196,9 +214,44 @@ def validate_fleet_json(obj) -> List[str]:
                                        and sec[key] >= 0):
                     probs.append(f"{where}: {section}.{key} must be an "
                                  f"int >= 0 (0 = default/auto)")
+            if section != "generation":
+                continue
+            # speculative-decoding knobs: the draft reference must
+            # resolve INSIDE this file to an engine="draft" entry, or
+            # the gate would charge a tenant the file never declares
+            if "draft" in sec:
+                d = sec["draft"]
+                if not isinstance(d, str) or not d:
+                    probs.append(f"{where}: generation.draft must name "
+                                 f"a fleet entry")
+                elif d not in kinds:
+                    probs.append(f"{where}: generation.draft {d!r} is "
+                                 f"not a fleet entry in this file")
+                elif kinds[d] != "draft":
+                    probs.append(f"{where}: generation.draft {d!r} "
+                                 f"must have engine 'draft', has "
+                                 f"{kinds[d]!r}")
+            for key in ("spec_gamma", "spec_gamma_max"):
+                if key in sec and not (isinstance(sec[key], int)
+                                       and sec[key] >= 0):
+                    probs.append(f"{where}: generation.{key} must be "
+                                 f"an int >= 0")
+            if "spec_gamma" in sec and isinstance(sec["spec_gamma"],
+                                                  int) \
+                    and sec["spec_gamma"] == 1:
+                probs.append(f"{where}: generation.spec_gamma must be "
+                             f"0 (off) or >= 2")
+            if sec.get("spec_policy") is not None \
+                    and sec["spec_policy"] not in ("fixed", "adaptive"):
+                probs.append(f"{where}: generation.spec_policy must be "
+                             f"'fixed' or 'adaptive'")
         if kind == "generation" and e.get("serve"):
             probs.append(f"{where}: generation tenants take a "
                          f"'generation' section, not 'serve'")
+        if kind == "draft" and (e.get("serve") or e.get("generation")):
+            probs.append(f"{where}: draft entries take no serve/"
+                         f"generation sections (they serve no traffic "
+                         f"of their own)")
     return probs
 
 

@@ -8,6 +8,7 @@ tensor-parallel over the mesh)."""
 
 from .decoder import GraphDecoder
 from .engine import GenerationEngine, GenerationMetrics, GenerationStream
+from .sampling import SamplingParams
 
 __all__ = ["GenerationEngine", "GenerationStream", "GenerationMetrics",
-           "GraphDecoder"]
+           "GraphDecoder", "SamplingParams"]

@@ -57,6 +57,7 @@ from ...op import OpContext, OpType
 from ...ops.attention import MultiHeadAttention, PositionEmbedding
 from ...ops.linear import Embedding
 from ...ops.rnn import LSTM
+from . import sampling
 from .pages import alloc_pool_arrays
 
 # ops that act position-wise over the sequence dim: running them on a
@@ -131,6 +132,9 @@ class GraphDecoder:
         self.supports_chunking = not self.has_state
         self._prefill_fns: Dict[int, object] = {}
         self._decode_fn = None
+        self._decode_sampled_fn = None
+        self._verify_fns: Dict[Tuple[int, bool], object] = {}
+        self._draft_fns: Dict[Tuple[int, bool], object] = {}
 
     # ---- validation ----------------------------------------------------
     def _validate(self) -> None:
@@ -290,39 +294,243 @@ class GraphDecoder:
         does — the engine==reference parity pin compares token ids."""
         if self._decode_fn is not None:
             return self._decode_fn
-        layers = self.model.layers
 
         def decode(params, caches, tokens, pos, table, write_pages,
                    write_rows):
-            ctx = self._ctx()
-            x = tokens[:, None]                          # (slots, 1)
-            values: Dict[int, jax.Array] = {self._input_uid: x}
-            new: Dict[str, Dict[str, jax.Array]] = {}
-            for op in layers:
-                ins = [values[t.uid] for t in op.inputs]
-                if isinstance(op, MultiHeadAttention):
-                    outs, kp, vp = op.decode_paged(
-                        params, ins[0], caches[op.name]["k"],
-                        caches[op.name]["v"], table, pos,
-                        write_pages, write_rows, ctx)
-                    new[op.name] = {"k": kp, "v": vp}
-                elif isinstance(op, LSTM):
-                    outs, h2, c2 = op.decode(
-                        params, ins[0], caches[op.name]["h"],
-                        caches[op.name]["c"], ctx)
-                    new[op.name] = {"h": h2, "c": c2}
-                elif isinstance(op, PositionEmbedding):
-                    outs = op.decode(params, ins[0], pos, ctx)
-                else:
-                    outs = op.forward(params, ins, ctx)
-                for t, val in zip(op.outputs, outs):
-                    values[t.uid] = val
-            logits = values[self._final_uid][:, 0]       # (slots, V)
+            logits, new = self._walk_decode(params, caches, tokens, pos,
+                                            table, write_pages,
+                                            write_rows)
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             return nxt, new
 
         self._decode_fn = jax.jit(decode, donate_argnums=(1,))
         return self._decode_fn
+
+    def decode_sampled_fn(self):
+        """The SAMPLED decode step: the same layer walk as
+        :meth:`decode_fn` with the argmax replaced by per-slot
+        temperature/top-k/top-p sampling from the request-seeded
+        on-device PRNG streams (``sampling.STREAM_MAIN`` folded with
+        the GLOBAL position of the token being drawn, so the same
+        (seed, request) replays the same tokens).  Slots with
+        ``temperature <= 0`` get the exact one-hot argmax distribution
+        — but the engine still routes ALL-greedy batches through
+        :meth:`decode_fn`, so the unsampled bit-parity pins never
+        depend on this program.
+        ``fn(params, caches, tokens, pos, table, write_pages,
+        write_rows, temp (slots,), top_k (slots,), top_p (slots,),
+        seeds (slots,)) -> (next_tokens, caches)``."""
+        if self._decode_sampled_fn is not None:
+            return self._decode_sampled_fn
+
+        def decode_s(params, caches, tokens, pos, table, write_pages,
+                     write_rows, temp, top_k, top_p, seeds):
+            logits, new = self._walk_decode(params, caches, tokens, pos,
+                                            table, write_pages,
+                                            write_rows)
+            probs = sampling.filtered_probs(logits, temp, top_k, top_p)
+            keys = sampling.position_keys(sampling.request_keys(seeds),
+                                          pos + 1, sampling.STREAM_MAIN)
+            nxt = sampling.categorical(keys, probs)
+            return nxt, new
+
+        self._decode_sampled_fn = jax.jit(decode_s, donate_argnums=(1,))
+        return self._decode_sampled_fn
+
+    # ---- speculative decoding (docs/serving.md "Speculative
+    # decoding & sampling") ----------------------------------------------
+    def _walk_decode(self, params, caches, tokens, pos, table,
+                     write_pages, write_rows):
+        """The shared single-position layer walk: returns the (slots,
+        V) logits + updated caches (the body of :meth:`decode_fn`,
+        factored so the sampled decode and the draft scan run the
+        IDENTICAL arithmetic)."""
+        ctx = self._ctx()
+        x = tokens[:, None]                              # (slots, 1)
+        values: Dict[int, jax.Array] = {self._input_uid: x}
+        new: Dict[str, Dict[str, jax.Array]] = {}
+        for op in self.model.layers:
+            ins = [values[t.uid] for t in op.inputs]
+            if isinstance(op, MultiHeadAttention):
+                outs, kp, vp = op.decode_paged(
+                    params, ins[0], caches[op.name]["k"],
+                    caches[op.name]["v"], table, pos,
+                    write_pages, write_rows, ctx)
+                new[op.name] = {"k": kp, "v": vp}
+            elif isinstance(op, LSTM):
+                outs, h2, c2 = op.decode(
+                    params, ins[0], caches[op.name]["h"],
+                    caches[op.name]["c"], ctx)
+                new[op.name] = {"h": h2, "c": c2}
+            elif isinstance(op, PositionEmbedding):
+                outs = op.decode(params, ins[0], pos, ctx)
+            else:
+                outs = op.forward(params, ins, ctx)
+            for t, val in zip(op.outputs, outs):
+                values[t.uid] = val
+        return values[self._final_uid][:, 0], new        # (slots, V)
+
+    def _walk_window(self, params, caches, window, pos, table,
+                     write_pages, write_rows):
+        """The W-position verify walk: ``window`` (slots, W) int32
+        tokens at global positions ``pos[i] .. pos[i]+W-1`` per slot,
+        through every op's window path — attention via
+        :meth:`~flexflow_tpu.ops.attention.MultiHeadAttention.
+        verify_paged` (the slot-batched chunked-prefill kernel),
+        position embeddings via ``decode_window``, position-wise ops
+        unchanged.  Returns the (slots, W, V) logits + updated caches.
+        Speculation requires ``supports_chunking`` (no LSTM): a cell
+        state cannot roll back to an accept point."""
+        ctx = self._ctx()
+        values: Dict[int, jax.Array] = {self._input_uid: window}
+        new: Dict[str, Dict[str, jax.Array]] = {}
+        for op in self.model.layers:
+            ins = [values[t.uid] for t in op.inputs]
+            if isinstance(op, MultiHeadAttention):
+                outs, kp, vp = op.verify_paged(
+                    params, ins[0], caches[op.name]["k"],
+                    caches[op.name]["v"], table, pos,
+                    write_pages, write_rows, ctx)
+                new[op.name] = {"k": kp, "v": vp}
+            elif isinstance(op, PositionEmbedding):
+                outs = op.decode_window(params, ins[0], pos, ctx)
+            else:
+                outs = op.forward(params, ins, ctx)
+            for t, val in zip(op.outputs, outs):
+                values[t.uid] = val
+        return values[self._final_uid], new              # (slots, W, V)
+
+    def verify_fn(self, width: int, sampled: bool = False):
+        """The jitted speculative-VERIFY program for one window width
+        W (== the round's γ): run the target over ``[last_token, d_1,
+        .., d_{W-1}]`` at positions ``pos .. pos+W-1`` per slot in ONE
+        dispatch — window row t's logits decide the token at position
+        ``pos+t+1``, compared against proposal ``d_{t+1}``.
+
+        Greedy (``sampled=False``):
+        ``fn(params, caches, first (slots,), d (slots, W), pos, table,
+        wp (slots, W), wr (slots, W)) -> ((n_accept (slots,), out
+        (slots, W)), caches)`` where ``out`` is the target argmax per
+        row — rows ``< n_accept`` equal the accepted proposals and row
+        ``n_accept`` (when < W) IS the correction token, so the host
+        emits ``out[i, :min(n+1, W)]`` verbatim.  Bit-identical to
+        sequential greedy decode by induction over accepted prefixes
+        (the parity pin).
+
+        Sampled (``sampled=True``) adds ``q (slots, W, V)`` draft
+        probs + per-slot strategy arrays, and applies seeded
+        rejection-sampling acceptance on device
+        (:func:`sampling.speculative_accept`), preserving the target
+        distribution exactly."""
+        key = (int(width), bool(sampled))
+        fn = self._verify_fns.get(key)
+        if fn is not None:
+            return fn
+        if not self.supports_chunking:
+            raise ValueError("speculative verify needs a chunkable "
+                             "graph (LSTM state cannot roll back)")
+        w = int(width)
+
+        def verify(params, caches, first, d, pos, table, wp, wr):
+            window = jnp.concatenate([first[:, None], d[:, :-1]],
+                                     axis=1)
+            logits, new = self._walk_window(params, caches, window, pos,
+                                            table, wp, wr)
+            tgt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            eq = (d == tgt).astype(jnp.int32)
+            n_acc = jnp.sum(jnp.cumprod(eq, axis=1),
+                            axis=1).astype(jnp.int32)
+            return (n_acc, tgt), new
+
+        def verify_s(params, caches, first, d, q, pos, table, wp, wr,
+                     temp, top_k, top_p, seeds):
+            slots = first.shape[0]
+            window = jnp.concatenate([first[:, None], d[:, :-1]],
+                                     axis=1)
+            logits, new = self._walk_window(params, caches, window, pos,
+                                            table, wp, wr)
+            flat = logits.reshape(slots * w, -1)
+            rep = lambda a: jnp.repeat(a, w)
+            p = sampling.filtered_probs(flat, rep(temp), rep(top_k),
+                                        rep(top_p))
+            p = p.reshape(slots, w, -1)
+            base = jnp.repeat(sampling.request_keys(seeds), w, axis=0)
+            tpos = (pos[:, None] + 1 + jnp.arange(w)).reshape(-1)
+            akeys = sampling.position_keys(
+                base, tpos, sampling.STREAM_ACCEPT).reshape(slots, w, 2)
+            rkeys = sampling.position_keys(
+                base, tpos, sampling.STREAM_RESIDUAL).reshape(slots, w,
+                                                             2)
+            n_acc, out = sampling.speculative_accept(d, p, q, akeys,
+                                                     rkeys)
+            return (n_acc, out), new
+
+        fn = jax.jit(verify_s if sampled else verify, donate_argnums=(1,))
+        self._verify_fns[key] = fn
+        return fn
+
+    def draft_fn(self, gamma: int, sampled: bool = False):
+        """The jitted γ-step DRAFT program: ONE dispatch scans γ decode
+        steps of the draft graph — step t feeds the token at position
+        ``pos+t`` (step 0: the stream's last token; later steps: the
+        previous proposal), writes the draft's K/V row there, and
+        proposes the token for position ``pos+t+1``.  After the scan
+        the draft cache covers exactly ``pos .. pos+γ-1`` — with the
+        no-bonus-token verify window the draft is exactly caught up
+        after EVERY round, accepted or not, so there is no draft
+        catch-up state to track.
+
+        Greedy: ``fn(params, caches, first (slots,), pos, table, wp
+        (γ, slots), wr (γ, slots)) -> (d (slots, γ), caches)``.
+        Sampled adds strategy arrays and also returns the per-step
+        draft distributions ``q (slots, γ, V)`` the rejection test
+        needs."""
+        key = (int(gamma), bool(sampled))
+        fn = self._draft_fns.get(key)
+        if fn is not None:
+            return fn
+        if not self.supports_chunking:
+            raise ValueError("speculative draft needs a chunkable "
+                             "graph (LSTM state cannot roll back)")
+
+        def draft(params, caches, first, pos, table, wp, wr):
+            def step(carry, xs):
+                tok, kv = carry
+                wp_t, wr_t, t = xs
+                logits, kv = self._walk_decode(params, kv, tok, pos + t,
+                                               table, wp_t, wr_t)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (nxt, kv), nxt
+
+            (_, new), d = jax.lax.scan(
+                step, (first, caches),
+                (wp, wr, jnp.arange(int(gamma))))
+            return jnp.transpose(d), new                 # (slots, γ)
+
+        def draft_s(params, caches, first, pos, table, wp, wr, temp,
+                    top_k, top_p, seeds):
+            base = sampling.request_keys(seeds)
+
+            def step(carry, xs):
+                tok, kv = carry
+                wp_t, wr_t, t = xs
+                logits, kv = self._walk_decode(params, kv, tok, pos + t,
+                                               table, wp_t, wr_t)
+                q = sampling.filtered_probs(logits, temp, top_k, top_p)
+                keys = sampling.position_keys(base, pos + t + 1,
+                                              sampling.STREAM_DRAFT)
+                nxt = sampling.categorical(keys, q)
+                return (nxt, kv), (nxt, q)
+
+            (_, new), (d, q) = jax.lax.scan(
+                step, (first, caches),
+                (wp, wr, jnp.arange(int(gamma))))
+            return (jnp.transpose(d),
+                    jnp.transpose(q, (1, 0, 2))), new
+
+        fn = jax.jit(draft_s if sampled else draft, donate_argnums=(1,))
+        self._draft_fns[key] = fn
+        return fn
 
     # ---- shared-instance registry --------------------------------------
     @classmethod

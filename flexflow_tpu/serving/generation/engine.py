@@ -71,6 +71,7 @@ from ..errors import (GenerationCancelled, KVCacheExhausted,
 from ..metrics import ServingMetrics
 from .decoder import GraphDecoder
 from .pages import KVPagePool, PrefixCache
+from .sampling import SamplingParams
 
 _END = object()  # token-stream sentinel
 
@@ -115,12 +116,16 @@ class GenerationStream:
     already iterated remain valid."""
 
     def __init__(self, prompt_len: int, max_new: int, t_submit: float,
-                 deadlined: bool = False, trace: Optional[str] = None):
+                 deadlined: bool = False, trace: Optional[str] = None,
+                 sampling: Optional[SamplingParams] = None):
         self.future: Future = Future()
         self.prompt_len = int(prompt_len)
         self.max_new = int(max_new)
         self.t_submit = t_submit
         self.deadlined = deadlined
+        # per-request sampling strategy (None/greedy keeps the stream
+        # on the unsampled argmax programs — the bit-parity anchor)
+        self.sampling = sampling
         # sampled trace id (obs.trace) or None; the engine records this
         # stream's queue/prefill/terminal spans against it
         self.trace = trace
@@ -213,15 +218,18 @@ class _Slot:
     excluded from decode dispatch writes (their write page rides the
     pool's OOB sentinel)."""
 
-    __slots__ = ("stream", "prompt", "pages", "hit_tokens", "next_pos",
-                 "chunks", "last_token", "length", "generated",
-                 "prefilling", "t_join")
+    __slots__ = ("stream", "prompt", "pages", "draft_pages",
+                 "hit_tokens", "next_pos", "chunks", "last_token",
+                 "length", "generated", "prefilling", "t_join")
 
     def __init__(self, stream: GenerationStream, prompt: np.ndarray,
                  hit_pages: List[int], page_size: int, t_join: float):
         self.stream = stream
         self.prompt = prompt
         self.pages: List[int] = list(hit_pages)
+        # the slot's pages in the DRAFT pool under speculation (no
+        # prefix sharing: draft rows are never promoted to the trie)
+        self.draft_pages: List[int] = []
         self.hit_tokens = len(hit_pages) * int(page_size)
         self.next_pos = self.hit_tokens  # next prompt position to prefill
         self.chunks = 0
@@ -264,6 +272,28 @@ class GenerationMetrics(ServingMetrics):
             "joins)", ("model", "eng"))
         self._ctr["tokens"] = self._fams["tokens"].labels(**kv)
         self._ctr["prefills"] = self._fams["prefills"].labels(**kv)
+        # speculative-decoding counters (ISSUE 16): registry-backed so
+        # gen_stats events and the /metrics scrape read the SAME
+        # children and can never diverge.  accept_rate in snapshot()
+        # is derived from these two totals, not tracked separately.
+        self._fams["draft_dispatches"] = reg.counter(
+            "ff_gen_draft_dispatches_total", "Speculative draft "
+            "dispatches (one γ-step scan per round)", ("model", "eng"))
+        self._fams["spec_proposed"] = reg.counter(
+            "ff_gen_spec_proposed_tokens_total", "Draft tokens "
+            "proposed to the verifier", ("model", "eng"))
+        self._fams["spec_accepted"] = reg.counter(
+            "ff_gen_spec_accepted_tokens_total", "Draft tokens the "
+            "verifier accepted", ("model", "eng"))
+        self._fams["spec_fallbacks"] = reg.counter(
+            "ff_gen_spec_fallbacks_total", "Demotions to plain decode "
+            "(draft failure or accept-rate collapse)", ("model", "eng"))
+        for k in ("draft_dispatches", "spec_proposed", "spec_accepted",
+                  "spec_fallbacks"):
+            self._ctr[k] = self._fams[k].labels(**kv)
+        # the engine's live speculation view (current γ, policy, state)
+        # merged into snapshot() like pool_stats_fn
+        self.spec_stats_fn = None
 
     @property
     def total_tokens(self) -> int:
@@ -288,6 +318,16 @@ class GenerationMetrics(ServingMetrics):
             while self._steps and self._steps[0][0] < horizon:
                 self._steps.popleft()
 
+    def record_spec_round(self, proposed: int, accepted: int) -> None:
+        """One speculative round: one draft dispatch, ``proposed``
+        draft tokens judged, ``accepted`` of them kept."""
+        self._ctr["draft_dispatches"].inc()
+        self._ctr["spec_proposed"].inc(int(proposed))
+        self._ctr["spec_accepted"].inc(int(accepted))
+
+    def record_spec_fallback(self) -> None:
+        self._ctr["spec_fallbacks"].inc()
+
     def record_prefill_token(self) -> None:
         """The prefill's first token counts toward tokens/s too."""
         now = self.clock()
@@ -304,6 +344,7 @@ class GenerationMetrics(ServingMetrics):
         # drop the engine-owned pool provider with the queue-depth one
         # (a retired engine must not be retained by the registry)
         self.pool_stats_fn = None
+        self.spec_stats_fn = None
         super().release()
 
     def snapshot(self) -> Dict:
@@ -325,6 +366,8 @@ class GenerationMetrics(ServingMetrics):
         def ms(v):
             return None if v != v else round(v * 1e3, 3)
 
+        proposed = int(self._ctr["spec_proposed"].value)
+        accepted = int(self._ctr["spec_accepted"].value)
         snap.update({
             "tokens_per_s": round(toks / span, 3),
             "tokens": total_tokens,
@@ -333,10 +376,20 @@ class GenerationMetrics(ServingMetrics):
             "ttft_p99_ms": ms(qt[0.99]),
             "tpot_p50_ms": ms(qp[0.5]), "tpot_p95_ms": ms(qp[0.95]),
             "tpot_p99_ms": ms(qp[0.99]),
+            # speculation totals (under speculation a "step" is a
+            # draft+verify ROUND, so tpot_* percentiles are per-round
+            # walls — tokens_per_s stays the honest cross-mode metric)
+            "draft_dispatches": int(
+                self._ctr["draft_dispatches"].value),
+            "spec_proposed_tokens": proposed,
+            "spec_accepted_tokens": accepted,
+            "accept_rate": (round(accepted / proposed, 4)
+                            if proposed else 0.0),
+            "spec_fallbacks": int(self._ctr["spec_fallbacks"].value),
         })
-        fn = self.pool_stats_fn
-        if fn is not None:
-            snap.update(fn())
+        for fn in (self.pool_stats_fn, self.spec_stats_fn):
+            if fn is not None:
+                snap.update(fn())
         return snap
 
     def emit(self, extra: Dict | None = None) -> None:
@@ -365,6 +418,16 @@ class GenerationEngine:
     REQUESTS here, one row each) unless overridden.  ``clock``/``sleep``
     are injectable for deterministic fault tests (RL008)."""
 
+    # speculation guardrails (class attrs so tests can tighten them):
+    # a draft whose EWMA accept rate sits below _SPEC_COLLAPSE_ACCEPT
+    # after _SPEC_COLLAPSE_MIN_PROPOSED proposals costs more than it
+    # saves — demote to plain decode rather than burn a draft dispatch
+    # per round for nothing
+    _SPEC_COLLAPSE_MIN_PROPOSED = 64
+    _SPEC_COLLAPSE_ACCEPT = 0.1
+    _SPEC_EWMA_ALPHA = 0.2        # per-round accept/cost EWMA weight
+    _SPEC_RETUNE_EVERY = 16       # adaptive γ re-pricing cadence
+
     def __init__(self, model, slots: Optional[int] = None,
                  max_seq: Optional[int] = None,
                  max_new_tokens: Optional[int] = None,
@@ -376,6 +439,10 @@ class GenerationEngine:
                  num_pages: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
                  prefix_cache: Optional[str] = None,
+                 draft_model=None,
+                 spec_gamma: Optional[int] = None,
+                 spec_gamma_max: Optional[int] = None,
+                 spec_policy: Optional[str] = None,
                  stats_every: int = 32, metrics_window_s: float = 30.0,
                  clock=time.monotonic, sleep=time.sleep,
                  name: str = ""):
@@ -482,6 +549,95 @@ class GenerationEngine:
         self._evictions_base = 0
         self._pool_high_base = 0
         self.metrics.pool_stats_fn = self._pool_stats
+        # ---- speculative decoding (docs/serving.md "Speculative
+        # decoding & sampling"): a co-hosted DRAFT model proposes γ
+        # tokens per round in one scanned dispatch; the target verifies
+        # the whole window in one chunked-prefill-class dispatch.  The
+        # draft owns its OWN page pool/table/caches with the SAME
+        # geometry (its rows mirror the target's positions 1:1), and
+        # the fleet gate charges them byte-for-byte.
+        self.draft_model = draft_model
+        self._draft_decoder = None
+        self._draft_pool: Optional[KVPagePool] = None
+        self._draft_table = None
+        self._draft_caches = None
+        self.draft_kv_cache_bytes = 0
+        g = int(cfg.serve_spec_gamma if spec_gamma is None
+                else spec_gamma) if draft_model is not None else 0
+        gmax = int(getattr(cfg, "serve_spec_gamma_max", 4)
+                   if spec_gamma_max is None else spec_gamma_max)
+        pol = str(getattr(cfg, "serve_spec_policy", "fixed")
+                  if spec_policy is None else spec_policy)
+        if pol not in ("fixed", "adaptive"):
+            raise ValueError(f"spec_policy must be 'fixed' or "
+                             f"'adaptive', got {pol!r}")
+        if draft_model is not None:
+            assert draft_model._compiled, \
+                "compile() + init_layers() the draft model first"
+            if pol == "fixed" and g == 0:
+                raise ValueError(
+                    "draft_model given but speculation is off "
+                    "(serve_spec_gamma=0, policy 'fixed'): set "
+                    "--serve-spec-gamma >= 2 or policy 'adaptive'")
+            if g != 0 and g < 2:
+                raise ValueError(
+                    f"spec_gamma must be 0 (off) or >= 2, got {g}: a "
+                    f"1-row verify window lowers matrix-vector kernels "
+                    f"whose bits drift from the full forward (same "
+                    f"floor as slots/serve_buckets)")
+            if gmax < max(g, 2):
+                raise ValueError(f"spec_gamma_max {gmax} < gamma "
+                                 f"{max(g, 2)}")
+            if not (self._decoder.has_attention
+                    and self._decoder.supports_chunking):
+                raise ValueError(
+                    "speculative decoding needs a chunkable causal-"
+                    "attention graph (LSTM state cannot roll back to "
+                    "an accept point)")
+            self._draft_decoder = GraphDecoder.for_model(
+                draft_model, self.slots, self.max_seq,
+                page_size=self.page_size, num_pages=self.num_pages)
+            if not self._draft_decoder.supports_chunking:
+                raise ValueError("draft model must be a chunkable "
+                                 "attention graph too")
+            tv = self._decoder.model.layers[-1].outputs[0].shape[-1]
+            dv = draft_model.layers[-1].outputs[0].shape[-1]
+            if tv != dv:
+                raise ValueError(f"draft vocab {dv} != target vocab "
+                                 f"{tv}: the proposals would not be "
+                                 f"token ids of the target")
+            self.draft_kv_plan = kv_page_plan(
+                draft_model.layers,
+                dict(draft_model.mesh.sizes)
+                if draft_model.mesh is not None else None,
+                self.slots, self.max_seq,
+                kv_dtype_bytes=dtype_bytes(cfg.compute_dtype),
+                page_size=self.page_size, num_pages=self.num_pages)
+            self.draft_kv_cache_bytes = self.draft_kv_plan["total_bytes"]
+            self._draft_pool = KVPagePool(self.num_pages, self.page_size)
+            self._draft_table = np.full(
+                (self.slots, self._draft_decoder.pages_per_slot),
+                self._draft_pool.no_page, np.int32)
+        self.spec_policy = pol
+        self.spec_gamma_max = gmax
+        # candidate γs the adaptive controller prices (fixed: just γ)
+        if draft_model is None:
+            self._spec_candidates: List[int] = []
+        elif pol == "fixed":
+            self._spec_candidates = [g]
+        else:
+            self._spec_candidates = sorted(
+                {c for c in (2, 4, gmax) if 2 <= c <= gmax})
+        self._spec_gamma = (self._spec_candidates[0]
+                            if self._spec_candidates else 0)
+        if pol == "fixed" and g:
+            self._spec_gamma = g
+        self._spec_on = draft_model is not None
+        self._spec_rounds = 0
+        self._accept_ewma: Optional[float] = None
+        self._spec_seen_proposed = 0
+        self._spec_costs: Dict[int, float] = {}  # per-γ round-wall EWMA
+        self.metrics.spec_stats_fn = self._spec_stats
         self._gen_faults: List[Dict] = []
         # lifecycle (same single-use contract as ServingEngine)
         self._thread: Optional[  # guarded_by: self._lifecycle
@@ -520,6 +676,52 @@ class GenerationEngine:
             np.full((self.slots,), self._pool.no_page, np.int32),
             np.zeros((self.slots,), np.int32))
         jax.device_get(nxt)
+        if self._spec_on:
+            self._warmup_spec()
+
+    def _warmup_spec(self) -> None:
+        """Compile the draft prefill buckets plus the draft-scan and
+        verify programs for every candidate γ (greedy variants; the
+        sampled ones compile on the first sampled request), and TIME
+        one dummy round per γ — the calibrated per-dispatch cost the
+        adaptive controller prices against the live accept rate.
+        Sentinel tables again: warmup writes all drop."""
+        dparams = self.draft_model._params
+        ddec = self._draft_decoder
+        no_row = np.full((ddec.pages_per_slot,),
+                         self._draft_pool.no_page, np.int32)
+        for b in ddec.buckets:
+            fn = ddec.prefill_fn(b)
+            _, self._draft_caches = fn(
+                dparams, self._draft_caches, np.zeros((1, b), np.int32),
+                no_row, np.int32(0), np.int32(0), np.int32(1))
+        dtable = np.full((self.slots, ddec.pages_per_slot),
+                         self._draft_pool.no_page, np.int32)
+        vtable = np.full((self.slots, self._decoder.pages_per_slot),
+                         self._pool.no_page, np.int32)
+        tokens = np.zeros((self.slots,), np.int32)
+        pos = np.zeros((self.slots,), np.int32)
+        for g in self._spec_candidates:
+            dwp = np.full((g, self.slots), self._draft_pool.no_page,
+                          np.int32)
+            dwr = np.zeros((g, self.slots), np.int32)
+            vwp = np.full((self.slots, g), self._pool.no_page, np.int32)
+            vwr = np.zeros((self.slots, g), np.int32)
+            dfn = ddec.draft_fn(g)
+            vfn = self._decoder.verify_fn(g)
+            # compile pass, then one timed pass = the per-γ cost seed
+            for probe in range(2):
+                t0 = self.clock()
+                d, self._draft_caches = dfn(
+                    dparams, self._draft_caches, tokens, pos, dtable,
+                    dwp, dwr)
+                (n_acc, out), self._caches = vfn(
+                    self.model._params, self._caches, tokens, d, pos,
+                    vtable, vwp, vwr)
+                jax.device_get((n_acc, out))
+                if probe:
+                    self._spec_costs[g] = max(1e-6,
+                                              self.clock() - t0)
 
     def start(self, warmup: bool = True) -> "GenerationEngine":
         with self._lifecycle:
@@ -530,6 +732,8 @@ class GenerationEngine:
                     "model, so a fresh engine starts warm)")
             if self._thread is None:
                 self._caches = self._decoder.init_cache()
+                if self._spec_on:
+                    self._draft_caches = self._draft_decoder.init_cache()
                 if warmup:
                     self._warmup()
                 self._gen_faults = _load_gen_faults()
@@ -543,7 +747,8 @@ class GenerationEngine:
                                   else "off"),
                     prefill_chunk=self.prefill_chunk,
                     admission=self.admission,
-                    max_queue_requests=self.max_queue_requests)
+                    max_queue_requests=self.max_queue_requests,
+                    **self._spec_stats())
                 self._thread = threading.Thread(
                     target=self._decode_loop, name="ff-generate",
                     daemon=True)
@@ -652,6 +857,8 @@ class GenerationEngine:
                     "engine already runs its own decode thread")
             if self._caches is None:
                 self._caches = self._decoder.init_cache()
+                if self._spec_on:
+                    self._draft_caches = self._draft_decoder.init_cache()
                 if warmup:
                     self._warmup()
                 self._gen_faults = _load_gen_faults()
@@ -666,7 +873,7 @@ class GenerationEngine:
                     prefill_chunk=self.prefill_chunk,
                     admission=self.admission,
                     max_queue_requests=self.max_queue_requests,
-                    external=True)
+                    external=True, **self._spec_stats())
         return self
 
     def dispatch_pending(self) -> Optional[float]:
@@ -688,7 +895,7 @@ class GenerationEngine:
             return max(0.0, self.clock() - t0) if progressed else None
         self._fire_slow_decode()
         try:
-            self._decode_once()
+            self._step_active()
         except BaseException as e:  # noqa: BLE001 — same containment
             # as _decode_loop: the step's failure is the streams', not
             # the fleet dispatcher's
@@ -706,7 +913,9 @@ class GenerationEngine:
     # ---- producer side -------------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                deadline_ms: Optional[float] = None,
-               priority: int = 0) -> GenerationStream:
+               priority: int = 0,
+               sampling: Optional[SamplingParams] = None
+               ) -> GenerationStream:
         """Queue one prompt (1-D int token ids) and return its
         :class:`GenerationStream`.  Thread-safe.
 
@@ -715,10 +924,20 @@ class GenerationEngine:
         ``deadline_ms``/``priority`` behave exactly like the serving
         engine's (PR 8): a prompt still queued at its deadline expires
         with DeadlineExceeded before any prefill is burned; under a
-        full bounded queue the admission policy applies per request."""
+        full bounded queue the admission policy applies per request.
+
+        ``sampling`` selects the request's decoding strategy
+        (temperature/top-k/top-p, seeded — see
+        :class:`~.sampling.SamplingParams`); None or temperature 0 is
+        greedy argmax, and a batch with no sampled request dispatches
+        the UNSAMPLED programs so the bit-parity pins hold exactly."""
         arr = np.asarray(prompt, dtype=np.int32).reshape(-1)
         if arr.size < 1:
             raise ValueError("empty prompt")
+        if sampling is not None and not isinstance(sampling,
+                                                   SamplingParams):
+            raise TypeError(f"sampling must be a SamplingParams, "
+                            f"got {type(sampling).__name__}")
         # None-check, not truthiness: an explicit 0 must hit the guard
         # below, not silently fall back to the config default
         max_new = (self.max_new_tokens if max_new_tokens is None
@@ -735,7 +954,7 @@ class GenerationEngine:
         trace = tr.new_trace() if tr.active else None
         stream = GenerationStream(arr.size, max_new, t0,
                                   deadlined=deadline_ms is not None,
-                                  trace=trace)
+                                  trace=trace, sampling=sampling)
         deadline = None if deadline_ms is None else t0 + deadline_ms / 1e3
         metrics = self.metrics
         trace_term = self._trace_terminal
@@ -852,7 +1071,7 @@ class GenerationEngine:
                    for s in self._slots_state):
                 self._fire_slow_decode()
                 try:
-                    self._decode_once()
+                    self._step_active()
                 except BaseException as e:  # noqa: BLE001 — one
                     # poisoned step must fail the ACTIVE streams, not
                     # kill the dispatcher; queued prompts still served
@@ -1012,10 +1231,12 @@ class GenerationEngine:
             self._tracer.span("queue", stream.trace, stream.t_submit,
                               st.t_join, tid=tname, slot=slot)
             self._tracer.span("prefill", stream.trace, st.t_join, now,
-                              tid=tname, slot=slot,
+                              tid=tname, slot=slot, phase="target",
                               prompt_len=int(prompt.size),
                               prefix_hit_tokens=st.hit_tokens,
                               prefill_chunks=st.chunks)
+        if self._spec_active():
+            self._draft_prefill(slot, st)
         self._retire(slot, st, now)
         return True
 
@@ -1071,6 +1292,11 @@ class GenerationEngine:
             self._pool.release(pg)
         st.pages = []
         self._table[slot, :] = self._pool.no_page
+        if self._draft_pool is not None:
+            for pg in st.draft_pages:
+                self._draft_pool.release(pg)
+            self._draft_table[slot, :] = self._draft_pool.no_page
+        st.draft_pages = []
         self._slots_state[slot] = None
 
     def _fail_slot(self, slot: int, st: _Slot, exc: BaseException,
@@ -1082,6 +1308,48 @@ class GenerationEngine:
         self._release_slot(slot, st)
 
     # ---- decode --------------------------------------------------------
+    def _step_active(self) -> None:
+        """Advance every active stream one boundary: a speculative
+        draft+verify ROUND when a live draft is attached, else one
+        plain decode step.  Callers wrap this in the dispatch-error
+        containment."""
+        if self._spec_active():
+            self._spec_decode_once()
+        else:
+            self._decode_once()
+
+    def _spec_active(self) -> bool:
+        return self._spec_on and self._spec_gamma >= 2
+
+    def _batch_sampling(self) -> bool:
+        """Whether ANY active slot carries a non-greedy strategy — the
+        routing bit: all-greedy batches dispatch the UNSAMPLED programs
+        so the bit-parity pins never depend on the sampled kernels."""
+        for s in self._slots_state:
+            if s is None or s.prefilling or s.stream.sampling is None:
+                continue
+            if not s.stream.sampling.is_greedy:
+                return True
+        return False
+
+    def _sampling_arrays(self):
+        """Per-slot strategy arrays for the sampled programs; inactive
+        and greedy slots ride the defaults (temp 0 -> exact one-hot
+        argmax inside the kernel)."""
+        temp = np.zeros((self.slots,), np.float32)
+        top_k = np.zeros((self.slots,), np.int32)
+        top_p = np.ones((self.slots,), np.float32)
+        seeds = np.zeros((self.slots,), np.int32)
+        for i, s in enumerate(self._slots_state):
+            if s is None or s.prefilling or s.stream.sampling is None:
+                continue
+            sp = s.stream.sampling
+            temp[i] = sp.temperature
+            top_k[i] = sp.top_k
+            top_p[i] = sp.top_p
+            seeds[i] = sp.seed
+        return temp, top_k, top_p, seeds
+
     def _decode_once(self) -> None:
         """Advance the whole decode batch one position: one dispatch,
         one token fetch, scatter to streams.  Write pages/rows are
@@ -1100,16 +1368,25 @@ class GenerationEngine:
                 wp[i] = self._table[i, s.length // self.page_size]
                 wr[i] = s.length % self.page_size
                 nactive += 1
-        fn = self._decoder.decode_fn()
+        sampled = self._batch_sampling()
         # ONE lock-free tracing check per decode step (hot-path
         # contract, docs/observability.md)
         traced = self._tracer.active
         t0 = self.clock()
         with jax.profiler.StepTraceAnnotation("generate",
                                               step_num=self._n_steps):
-            nxt, self._caches = fn(self.model._params, self._caches,
-                                   tokens, pos, self._table.copy(),
-                                   wp, wr)
+            if sampled:
+                temp, top_k, top_p, seeds = self._sampling_arrays()
+                fn = self._decoder.decode_sampled_fn()
+                nxt, self._caches = fn(
+                    self.model._params, self._caches, tokens, pos,
+                    self._table.copy(), wp, wr, temp, top_k, top_p,
+                    seeds)
+            else:
+                fn = self._decoder.decode_fn()
+                nxt, self._caches = fn(self.model._params, self._caches,
+                                       tokens, pos, self._table.copy(),
+                                       wp, wr)
             # THE one host sync per decode step for the whole batch —
             # per-stream tokens are scattered from it below (RL010)
             host = np.asarray(jax.device_get(nxt))
@@ -1127,12 +1404,331 @@ class GenerationEngine:
         if traced:
             self._tracer.span("decode_step", None, t0, now,
                               tid=self.name or "generate",
-                              step=self._n_steps - 1, active=nactive)
+                              step=self._n_steps - 1, active=nactive,
+                              phase="decode")
         self.metrics.record_decode_step(nactive, now - t0)
         self._fire_cancel_at_token(now)
         if self.stats_every and self._n_steps % self.stats_every == 0:
             self.metrics.emit(extra={"slots": self.slots,
                                      "active": nactive})
+
+    # ---- speculative round ---------------------------------------------
+    def _spec_decode_once(self) -> None:
+        """One speculative ROUND for the whole batch: the draft scans
+        γ decode steps in ONE dispatch, the target verifies the whole
+        window in ONE dispatch (the slot-batched chunked-prefill
+        kernel), and ONE host fetch brings back the accept counts plus
+        the emit-ready token rows — 2 dispatches + 1 sync per up-to-γ
+        tokens, vs γ of each for plain decode (RL010's budget, spent
+        better).
+
+        No rollback state: ``out[i, :min(n+1, γ)]`` is emitted verbatim
+        (accepted proposals then the correction), the draft cache is
+        exactly caught up after every round by construction (the
+        no-bonus window), and rows written beyond the accept point stay
+        invisible behind the causal mask until overwritten.  Trailing
+        pages past the accepted length go back to the pools
+        immediately."""
+        g = self._spec_gamma
+        # provision BOTH pools for the whole window up front; positions
+        # past max_seq ride the sentinel (their writes drop, and the
+        # prompt+max_new<=max_seq budget retires the stream before any
+        # such row could be emitted)
+        for i, s in enumerate(self._slots_state):
+            if s is None or s.prefilling:
+                continue
+            upto = min(s.length + g, self.max_seq)
+            if not self._ensure_pages(i, s, upto):
+                self._fail_slot(i, s, KVCacheExhausted(
+                    f"no KV page free for a γ={g} verify window at "
+                    f"position {s.length} (pool {self.num_pages} "
+                    f"pages, {self._pool.pages_in_use} in use)"),
+                    "shed")
+                continue
+            if not self._ensure_draft_pages(i, s, upto):
+                self._fail_slot(i, s, KVCacheExhausted(
+                    f"no DRAFT KV page free at position {s.length} "
+                    f"(draft pool {self.num_pages} pages, "
+                    f"{self._draft_pool.pages_in_use} in use)"), "shed")
+        active = [(i, s) for i, s in enumerate(self._slots_state)
+                  if s is not None and not s.prefilling]
+        if not active:
+            return
+        nactive = len(active)
+        tokens = np.zeros((self.slots,), np.int32)
+        pos = np.zeros((self.slots,), np.int32)
+        vwp = np.full((self.slots, g), self._pool.no_page, np.int32)
+        vwr = np.zeros((self.slots, g), np.int32)
+        dwp = np.full((g, self.slots), self._draft_pool.no_page,
+                      np.int32)
+        dwr = np.zeros((g, self.slots), np.int32)
+        for i, s in active:
+            tokens[i] = s.last_token
+            pos[i] = s.length
+            for t in range(g):
+                p = s.length + t
+                if p >= self.max_seq:
+                    break  # sentinel stays: the write drops
+                vwp[i, t] = self._table[i, p // self.page_size]
+                vwr[i, t] = p % self.page_size
+                dwp[t, i] = self._draft_table[i, p // self.page_size]
+                dwr[t, i] = p % self.page_size
+        sampled = self._batch_sampling()
+        if sampled:
+            temp, top_k, top_p, seeds = self._sampling_arrays()
+        traced = self._tracer.active
+        t0 = self.clock()
+        try:
+            self._fire_spec_draft_fail()
+            with jax.profiler.StepTraceAnnotation(
+                    "gen-draft", step_num=self._n_steps):
+                if sampled:
+                    dfn = self._draft_decoder.draft_fn(g, sampled=True)
+                    (d, q), self._draft_caches = dfn(
+                        self.draft_model._params, self._draft_caches,
+                        tokens, pos, self._draft_table.copy(), dwp,
+                        dwr, temp, top_k, top_p, seeds)
+                else:
+                    dfn = self._draft_decoder.draft_fn(g)
+                    d, self._draft_caches = dfn(
+                        self.draft_model._params, self._draft_caches,
+                        tokens, pos, self._draft_table.copy(), dwp,
+                        dwr)
+        except BaseException as e:  # noqa: BLE001 — draft-side only:
+            # the TARGET caches were never touched, so no stream fails;
+            # demote and decode this boundary plain
+            self._spec_demote("draft_error", e)
+            self._decode_once()
+            return
+        t1 = self.clock()
+        if traced:
+            self._tracer.span("decode_step", None, t0, t1,
+                              tid=self.name or "generate",
+                              step=self._n_steps, phase="draft",
+                              gamma=g, active=nactive)
+        # verify failures propagate to the caller's containment: the
+        # donated target caches are poisoned, so _recover_from_
+        # dispatch_error must fail the streams and rebuild everything
+        vfn = self._decoder.verify_fn(g, sampled=sampled)
+        with jax.profiler.StepTraceAnnotation(
+                "generate", step_num=self._n_steps):
+            if sampled:
+                (n_acc, out), self._caches = vfn(
+                    self.model._params, self._caches, tokens, d, q,
+                    pos, self._table.copy(), vwp, vwr, temp, top_k,
+                    top_p, seeds)
+            else:
+                (n_acc, out), self._caches = vfn(
+                    self.model._params, self._caches, tokens, d, pos,
+                    self._table.copy(), vwp, vwr)
+            # THE one host sync per round for the whole batch (RL010):
+            # accept counts + the emit-ready token rows together
+            n_host, out_host = jax.device_get((n_acc, out))
+        n_host = np.asarray(n_host)
+        out_host = np.asarray(out_host)
+        now = self.clock()
+        self._n_steps += 1
+        emitted = proposed = accepted = 0
+        for i, s in active:
+            n = int(n_host[i])
+            proposed += g
+            accepted += n
+            # rows < n are the accepted proposals; row n (when < γ) is
+            # the verifier's correction — emit in order, stopping
+            # EXACTLY where the sequential engine stops (EOS /
+            # max_new can land mid-window)
+            for t in range(min(n + 1, g)):
+                tok = int(out_host[i, t])
+                s.length += 1
+                s.generated += 1
+                s.last_token = tok
+                s.stream._emit(tok)
+                emitted += 1
+                if s.generated >= s.stream.max_new or (
+                        self.eos_id is not None
+                        and tok == self.eos_id):
+                    break
+            self._trim_slot_pages(i, s)
+            self._retire(i, s, now)
+        if traced:
+            self._tracer.span("decode_step", None, t1, now,
+                              tid=self.name or "generate",
+                              step=self._n_steps - 1, phase="verify",
+                              gamma=g, active=nactive,
+                              proposed=proposed, accepted=accepted)
+        self.metrics.record_spec_round(proposed, accepted)
+        # TPOT percentiles become per-ROUND walls here (documented in
+        # GenerationMetrics.snapshot); tokens_per_s stays comparable
+        self.metrics.record_decode_step(emitted, now - t0)
+        self._spec_account(g, proposed, accepted, now - t0)
+        self._fire_cancel_at_token(now)
+        if self.stats_every and self._n_steps % self.stats_every == 0:
+            self.metrics.emit(extra={"slots": self.slots,
+                                     "active": nactive})
+
+    def _ensure_draft_pages(self, slot: int, st: _Slot,
+                            upto_pos: int) -> bool:
+        """Grow the slot's DRAFT page table to cover positions
+        ``[0, upto_pos)`` — same geometry as the target's, but no
+        prefix sharing (draft rows are never promoted to the trie) and
+        so no eviction pressure valve."""
+        need = (int(upto_pos) - 1) // self.page_size + 1
+        while len(st.draft_pages) < need:
+            pg = self._draft_pool.alloc()
+            if pg is None:
+                return False
+            self._draft_table[slot, len(st.draft_pages)] = pg
+            st.draft_pages.append(pg)
+        return True
+
+    def _trim_slot_pages(self, slot: int, st: _Slot) -> None:
+        """Release the trailing pages a partially-accepted window
+        provisioned past the accept point, in BOTH pools — the
+        page-granular rollback (rejected rows inside kept pages need no
+        rollback at all: the causal mask hides them until the next
+        round overwrites them).  Released target pages sit strictly
+        after the shared prompt prefix (length >= prompt.size), so
+        their refcount is 1 and they return to the pool for real."""
+        keep = st.length // self.page_size + 1
+        while len(st.pages) > keep:
+            pg = st.pages.pop()
+            self._table[slot, len(st.pages)] = self._pool.no_page
+            self._pool.release(pg)
+        while len(st.draft_pages) > keep:
+            pg = st.draft_pages.pop()
+            self._draft_table[slot, len(st.draft_pages)] = \
+                self._draft_pool.no_page
+            self._draft_pool.release(pg)
+
+    def _draft_prefill(self, slot: int, st: _Slot) -> None:
+        """Mirror a freshly-joined stream's prompt into the DRAFT cache
+        with ONE monolithic prefill dispatch (no chunking, no prefix
+        sharing — draft rows are private, and the draft is a fraction
+        of the target so one chunk is cheap).  No host sync: the
+        draft's own next-token argmax is unused — round 0 scans from
+        the TARGET's real first token.  Any draft-side failure demotes
+        speculation; the stream itself is untouched."""
+        prompt = st.prompt
+        size = int(prompt.size)
+        if st.generated >= st.stream.max_new or (
+                self.eos_id is not None
+                and st.last_token == self.eos_id):
+            return  # retiring at this boundary: no draft rows needed
+        try:
+            if not self._ensure_draft_pages(slot, st, size):
+                raise KVCacheExhausted(
+                    f"no draft KV page free for a {size}-token prompt "
+                    f"({self._draft_pool.pages_in_use} of "
+                    f"{self.num_pages} in use)")
+            bucket = self._draft_decoder.prefill_bucket(size)
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :size] = prompt
+            fn = self._draft_decoder.prefill_fn(bucket)
+            t0 = self.clock()
+            with jax.profiler.StepTraceAnnotation(
+                    "gen-draft-prefill", step_num=self._n_steps):
+                _, self._draft_caches = fn(
+                    self.draft_model._params, self._draft_caches,
+                    tokens, self._draft_table[slot].copy(),
+                    np.int32(slot), np.int32(0), np.int32(size))
+            if self._tracer.active and st.stream.trace is not None:
+                self._tracer.span("prefill", st.stream.trace, t0,
+                                  self.clock(),
+                                  tid=self.name or "generate",
+                                  slot=slot, phase="draft",
+                                  prompt_len=size)
+        except BaseException as e:  # noqa: BLE001 — draft-side only:
+            # demote and keep serving plain; the target stream already
+            # has its first token
+            self._spec_demote("draft_prefill_error", e)
+
+    def _spec_account(self, g: int, proposed: int, accepted: int,
+                      wall: float) -> None:
+        """Post-round controller bookkeeping: accept-rate EWMA, per-γ
+        round-cost EWMA, the accept-collapse guard, and (adaptive
+        policy) the periodic γ re-pricing."""
+        self._spec_rounds += 1
+        self._spec_seen_proposed += proposed
+        a = self._SPEC_EWMA_ALPHA
+        if proposed:
+            rate = accepted / proposed
+            self._accept_ewma = (
+                rate if self._accept_ewma is None
+                else (1 - a) * self._accept_ewma + a * rate)
+        prev = self._spec_costs.get(g)
+        self._spec_costs[g] = (wall if prev is None
+                               else (1 - a) * prev + a * wall)
+        if (self._spec_seen_proposed >= self._SPEC_COLLAPSE_MIN_PROPOSED
+                and self._accept_ewma is not None
+                and self._accept_ewma < self._SPEC_COLLAPSE_ACCEPT):
+            # a useless draft burns a dispatch per round for ~nothing —
+            # the engine is FASTER without it
+            self._spec_demote("accept_collapse", None)
+            return
+        if (self.spec_policy == "adaptive"
+                and len(self._spec_candidates) > 1
+                and self._spec_rounds % self._SPEC_RETUNE_EVERY == 0):
+            self._spec_gamma = self._spec_retune()
+
+    def _spec_retune(self) -> int:
+        """Price each candidate γ with the live accept-rate EWMA α and
+        its calibrated round-wall EWMA (warmup-seeded, live-updated):
+        expected emitted tokens per round is ``(1 - α^γ) / (1 - α)``
+        (accepted prefix + correction, no bonus token), so the winner
+        maximizes that over its cost — the gen_stats feedback loop
+        pricing depth like the SOAP cost model prices strategies."""
+        alpha = self._accept_ewma if self._accept_ewma is not None \
+            else 0.5
+        alpha = min(0.999, max(0.001, alpha))
+        best, best_rate = self._spec_gamma, -1.0
+        for g in self._spec_candidates:
+            cost = self._spec_costs.get(g)
+            if not cost or cost <= 0:
+                continue
+            exp_tokens = (1.0 - alpha ** g) / (1.0 - alpha)
+            rate = exp_tokens / cost
+            if rate > best_rate:
+                best, best_rate = g, rate
+        return best
+
+    def _spec_demote(self, reason: str, exc) -> None:
+        """Demote to plain decode for the rest of the engine's
+        lifetime: drop the draft pool/table/caches (their HBM frees),
+        count the fallback, emit ONE serve_health event.  NO stream
+        fails — the target's state is untouched; every active stream
+        keeps generating plain from exactly where it is."""
+        if not self._spec_on:
+            return
+        self._spec_on = False
+        self._spec_gamma = 0
+        self._draft_caches = None
+        self._draft_pool = None
+        self._draft_table = None
+        self.draft_kv_cache_bytes = 0
+        for s in self._slots_state:
+            if s is not None:
+                s.draft_pages = []
+        self.metrics.record_spec_fallback()
+        get_logger("serve").event(
+            "serve_health", model=self.name, component="speculation",
+            status="fallback", reason=reason,
+            error=("" if exc is None
+                   else f"{type(exc).__name__}: {exc}"[:300]),
+            step=self._n_steps,
+            accept_ewma=(round(self._accept_ewma, 4)
+                         if self._accept_ewma is not None else None))
+
+    def _spec_stats(self) -> Dict:
+        """The live speculation view merged into gen_stats/stats():
+        off (no draft configured) / on / fallback (demoted)."""
+        state = ("off" if self.draft_model is None
+                 else ("on" if self._spec_on else "fallback"))
+        return {
+            "spec": state,
+            "spec_gamma": self._spec_gamma,
+            "spec_policy": self.spec_policy,
+            "draft_kv_cache_bytes": self.draft_kv_cache_bytes,
+        }
 
     def _recover_from_dispatch_error(self, e: BaseException,
                                      event: str) -> None:
@@ -1166,6 +1762,16 @@ class GenerationEngine:
                                self._decoder.pages_per_slot),
                               self._pool.no_page, np.int32)
         self._caches = self._decoder.init_cache()
+        if self._spec_on:
+            # the draft's pool/table/caches are re-armed with the
+            # target's: the failed round may have donated either side,
+            # and the slots they described are gone regardless
+            self._draft_pool = KVPagePool(self.num_pages,
+                                          self.page_size)
+            self._draft_table = np.full(
+                (self.slots, self._draft_decoder.pages_per_slot),
+                self._draft_pool.no_page, np.int32)
+            self._draft_caches = self._draft_decoder.init_cache()
         get_logger("serve").event(  # RL011-ok: gen_decode_error |
             # gen_prefill_error, both declared in obs/events.py —
             # callers pass the literal
@@ -1220,6 +1826,18 @@ class GenerationEngine:
             if st["kind"] == "serve_slow_decode" and st["fired"] < st["n"]:
                 st["fired"] += 1
                 self._sleep(st["ms"] / 1e3)
+
+    def _fire_spec_draft_fail(self) -> None:
+        """``FF_FAULT=spec_draft_fail:N`` — the Nth draft dispatch
+        raises (once), exercising the demote-to-plain-decode path: the
+        serve_health fallback event fires and NO stream fails."""
+        for st in self._gen_faults:
+            if st["kind"] == "spec_draft_fail" and not st["fired"] \
+                    and self._spec_rounds + 1 >= st["n"]:
+                st["fired"] = 1
+                raise RuntimeError(
+                    f"FF_FAULT spec_draft_fail: injected draft "
+                    f"failure at round {self._spec_rounds + 1}")
 
     def _fire_cancel_at_token(self, now: float) -> None:
         for st in self._gen_faults:

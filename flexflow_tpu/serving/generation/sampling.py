@@ -1,0 +1,205 @@
+"""Sampling strategies + speculative rejection acceptance (docs/
+serving.md "Speculative decoding & sampling").
+
+Everything here is pure jnp and runs INSIDE the jitted decode/draft/
+verify programs — no host RNG, no wall-clock entropy (RL014: serving
+randomness must derive from the per-request seed the caller threads
+through).  Keys are raw ``jax.random.PRNGKey(seed)`` keys folded with
+the GLOBAL token position plus a small stream tag, so
+
+* the same ``(seed, request)`` replays the same tokens run over run
+  (the determinism pin in tests/test_generation.py), and
+* the draft proposal, the accept/reject uniform and the residual
+  resample at one position are three INDEPENDENT streams — the
+  independence the rejection-sampling exactness argument needs (the
+  uniform must not be correlated with the proposal it judges).
+
+The acceptance rule is Leviathan-style speculative sampling: accept
+draft token ``x ~ q`` with probability ``min(1, p(x)/q(x))``; on the
+first rejection resample from the residual ``norm(max(p - q, 0))``.
+The marginal of the emitted token is exactly ``p`` — pinned by the
+seeded property test against the direct target sampler.  With one-hot
+(greedy) distributions the rule degenerates to an argmax equality
+check with an argmax correction, which is why greedy speculation can
+share this machinery at all.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # same finite mask value as ops.attention
+
+# fold_in stream tags: one sub-stream per distinct random decision at a
+# given (seed, position) so they are mutually independent
+STREAM_MAIN = 0      # plain (non-speculative) sampled decode
+STREAM_DRAFT = 1     # draft proposal at a position
+STREAM_ACCEPT = 2    # accept/reject uniform at a position
+STREAM_RESIDUAL = 3  # residual resample at the first rejected position
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling strategy.  ``temperature <= 0`` is greedy
+    argmax (the default — and the engine keeps all-greedy batches on
+    the unsampled decode program so the bit-parity pins hold exactly);
+    ``top_k <= 0`` keeps the whole vocabulary; ``top_p`` is the nucleus
+    mass (1.0 = no nucleus cut).  ``seed`` is the request's PRNG root:
+    sampling is deterministic per (seed, request)."""
+
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0, "
+                             f"got {self.temperature}")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {self.top_k}")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+
+    @property
+    def is_greedy(self) -> bool:
+        return self.temperature <= 0.0
+
+
+GREEDY = SamplingParams()
+
+
+# ---- key plumbing (inside jitted programs) -----------------------------
+def request_keys(seeds):
+    """(n,) int32 per-slot seeds -> (n, 2) raw PRNG keys."""
+    return jax.vmap(jax.random.PRNGKey)(seeds)
+
+
+def position_keys(keys, pos, stream: int):
+    """Fold the GLOBAL token position plus a stream tag into each
+    slot's root key: ``keys`` (n, 2), ``pos`` (n,) int32 -> (n, 2)."""
+    k = jax.vmap(jax.random.fold_in)(keys, pos)
+    return jax.vmap(jax.random.fold_in, in_axes=(0, None))(k, stream)
+
+
+def uniform_01(keys):
+    """(..., 2) keys -> (...,) independent U[0,1) floats."""
+    shape = keys.shape[:-1]
+    flat = keys.reshape(-1, 2)
+    u = jax.vmap(lambda k: jax.random.uniform(k))(flat)
+    return u.reshape(shape)
+
+
+def probs_to_logits(p):
+    """Normalized probs -> logits with exact ``NEG_INF`` at zero mass
+    (so ``jax.random.categorical`` can never emit a filtered token)."""
+    return jnp.where(p > 0.0, jnp.log(jnp.maximum(p, 1e-38)), NEG_INF)
+
+
+def categorical(keys, probs):
+    """(..., 2) keys + (..., V) probs -> (...,) int32 draws.  One-hot
+    rows return their argmax deterministically (the Gumbel perturbation
+    is finite; ``NEG_INF`` mass can never win)."""
+    lead = probs.shape[:-1]
+    v = probs.shape[-1]
+    flat_k = keys.reshape(-1, 2)
+    flat_p = probs.reshape(-1, v)
+    t = jax.vmap(jax.random.categorical)(flat_k, probs_to_logits(flat_p))
+    return t.reshape(lead).astype(jnp.int32)
+
+
+# ---- strategy: logits -> filtered target distribution ------------------
+def filtered_probs(logits, temperature, top_k, top_p):
+    """Apply per-row temperature / top-k / top-p and normalize:
+    ``logits`` (n, V) + (n,) strategy arrays -> (n, V) probs.
+
+    Rows with ``temperature <= 0`` come back as the EXACT one-hot of
+    ``argmax(logits)`` — the same argmax the unsampled decode program
+    takes, so a greedy request routed through the sampled program still
+    emits the greedy token (ties break identically: same op, same
+    input).  Ties AT the top-p cut value stay in (the standard
+    keep-at-least-the-nucleus convention)."""
+    logits = logits.astype(jnp.float32)
+    n, v = logits.shape
+    greedy = temperature <= 0.0
+    t = jnp.where(greedy, 1.0, temperature)
+    scaled = logits / t[:, None]
+    # top-k: keep the k largest (k <= 0 keeps all)
+    k = jnp.clip(jnp.where(top_k <= 0, v, top_k), 1, v)
+    desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    kth = jnp.take_along_axis(desc, (k - 1)[:, None], axis=-1)
+    scaled = jnp.where(scaled < kth, NEG_INF, scaled)
+    # top-p nucleus over the k-survivors: keep the smallest prefix of
+    # the sorted probs whose mass reaches top_p (top-1 always kept)
+    probs = jax.nn.softmax(scaled, axis=-1)
+    sp = jnp.sort(probs, axis=-1)[:, ::-1]
+    csum = jnp.cumsum(sp, axis=-1)
+    keep = (csum - sp) < top_p[:, None]
+    cut = jnp.min(jnp.where(keep, sp, jnp.inf), axis=-1)
+    scaled = jnp.where(probs < cut[:, None], NEG_INF, scaled)
+    probs = jax.nn.softmax(scaled, axis=-1)
+    onehot = jax.nn.one_hot(jnp.argmax(logits, axis=-1), v,
+                            dtype=jnp.float32)
+    return jnp.where(greedy[:, None], onehot, probs)
+
+
+# ---- speculative acceptance --------------------------------------------
+def residual_probs(p, q):
+    """The rejection residual ``norm(max(p - q, 0))`` per row; rows
+    where p == q (zero residual) fall back to ``p`` itself — any token
+    there was accepted with probability 1, so the branch only guards
+    numerics, never changes the marginal."""
+    r = jnp.maximum(p - q, 0.0)
+    rs = jnp.sum(r, axis=-1, keepdims=True)
+    return jnp.where(rs > 0.0, r / jnp.where(rs > 0.0, rs, 1.0), p)
+
+
+def speculative_accept(d, p, q, accept_keys, residual_keys):
+    """Vectorized rejection-sampling acceptance over a verify window.
+
+    ``d`` (n, W) draft proposals; ``p``/``q`` (n, W, V) target/draft
+    probs at the SAME positions; ``accept_keys``/``residual_keys``
+    (n, W, 2) per-position key streams.  Returns ``(n_accept (n,),
+    out (n, W))``: ``out[:, :n]`` are the accepted draft tokens and
+    ``out[:, n]`` (when n < W) is the residual resample — exactly the
+    tokens the stream emits, in order.  The marginal of each emitted
+    token is the target distribution ``p`` (seeded property test in
+    tests/test_generation.py)."""
+    n, w, _ = p.shape
+    pd = jnp.take_along_axis(p, d[..., None], axis=-1)[..., 0]
+    qd = jnp.take_along_axis(q, d[..., None], axis=-1)[..., 0]
+    u = uniform_01(accept_keys)                              # (n, W)
+    # accept with prob min(1, p/q): u*q <= p avoids the 0/0 division
+    accept = u * qd <= pd
+    cum = jnp.cumprod(accept.astype(jnp.int32), axis=-1)
+    n_acc = jnp.sum(cum, axis=-1)                            # (n,)
+    # residual resample at the FIRST rejected position (index clipped
+    # for full-accept rows, whose resample is computed then discarded)
+    idx = jnp.minimum(n_acc, w - 1)
+    p_r = jnp.take_along_axis(p, idx[:, None, None], axis=1)[:, 0]
+    q_r = jnp.take_along_axis(q, idx[:, None, None], axis=1)[:, 0]
+    keys_r = jnp.take_along_axis(residual_keys, idx[:, None, None],
+                                 axis=1)[:, 0]               # (n, 2)
+    c = categorical(keys_r, residual_probs(p_r, q_r))        # (n,)
+    out = jnp.where(jnp.arange(w)[None, :] == n_acc[:, None],
+                    c[:, None], d)
+    return n_acc.astype(jnp.int32), out.astype(jnp.int32)
+
+
+def speculative_sample(key, p, q, n: int):
+    """Reference single-position speculative sampler for the property
+    test: draw ``n`` independent tokens through the draft -> accept ->
+    residual path with target probs ``p`` (V,) and draft probs ``q``
+    (V,).  The returned empirical distribution must match ``p`` — the
+    per-position invariant the windowed :func:`speculative_accept`
+    inherits by induction."""
+    kd, ku, kr = jax.random.split(key, 3)
+    d = jax.random.categorical(kd, probs_to_logits(q), shape=(n,))
+    u = jax.random.uniform(ku, (n,))
+    accept = u * q[d] <= p[d]
+    r = residual_probs(p[None], q[None])[0]
+    c = jax.random.categorical(kr, probs_to_logits(r), shape=(n,))
+    return jnp.where(accept, d, c).astype(jnp.int32)

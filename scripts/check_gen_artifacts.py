@@ -8,6 +8,11 @@
   high-water <= the dense baseline, reconciliation) must all be True —
   a regression that flips one can never land silently with the old
   artifact still claiming the win.
+* ``artifacts/spec_bench_r17.json`` — the ISSUE 16 speculative-
+  decoding evidence: the gamma x sampling sweep's structural schema
+  PLUS the acceptance booleans (tokens/s win over the gamma=0 arm,
+  greedy bit-parity, sampled reproducibility) must all be True, and
+  the win boolean must agree with the recorded per-arm tokens_per_s.
 * ``artifacts/pallas_flags_*.json`` — the per-device-kind Pallas
   decision artifacts ``scripts/decide_pallas_flags.sh`` emits: each
   must carry the schema version, device kind, and an on/speedup/row
@@ -119,6 +124,72 @@ def check_prefix_bench(path: str = PREFIX_BENCH) -> int:
     return rc
 
 
+SPEC_BENCH = os.path.join(REPO, "artifacts", "spec_bench_r17.json")
+
+_SPEC_ACCEPTANCE = ("spec_tokens_win", "greedy_parity",
+                    "sampled_reproducible")
+
+
+def check_spec_bench(path: str = SPEC_BENCH) -> int:
+    try:
+        with open(path) as f:
+            p = json.load(f)
+    except OSError as e:
+        return _fail(f"cannot read {os.path.relpath(path, REPO)}: {e}")
+    except ValueError as e:
+        return _fail(f"{os.path.relpath(path, REPO)} is not JSON: {e}")
+    rc = 0
+    if p.get("bench") != "gen-spec":
+        rc |= _fail(f"bench must be 'gen-spec', got {p.get('bench')!r}")
+    for key in ("config", "arms", "acceptance"):
+        if not isinstance(p.get(key), dict):
+            rc |= _fail(f"missing/non-object section {key!r}")
+    if rc:
+        return rc
+    if "device_kind" not in p or "comm_plan_digest" not in p:
+        rc |= _fail("payload lacks the PR 7/PR 9 device_kind/"
+                    "comm_plan_digest stamps")
+    for mode in ("greedy", "temperature"):
+        rows = p["arms"].get(mode)
+        if not isinstance(rows, list) or len(rows) < 2:
+            rc |= _fail(f"arms.{mode} must list the gamma sweep "
+                        f"(>= 2 rows: gamma=0 baseline + speculation)")
+            continue
+        for row in rows:
+            for k in ("tokens_per_s", "tpot_p50_ms", "tpot_p95_ms",
+                      "tpot_p99_ms", "accept_rate",
+                      "draft_dispatches"):
+                if not _num(row.get(k)):
+                    rc |= _fail(f"arms.{mode}[{row.get('arm')!r}].{k} "
+                                f"must be numeric")
+            if not isinstance(row.get("arm"), str):
+                rc |= _fail(f"arms.{mode} row lacks an 'arm' label")
+        if rows[0].get("arm") != "g0":
+            rc |= _fail(f"arms.{mode}[0] must be the gamma=0 baseline")
+    if rc:
+        return rc
+    acc = p["acceptance"]
+    for k in _SPEC_ACCEPTANCE:
+        if acc.get(k) is not True:
+            rc |= _fail(f"acceptance.{k} must be true (got {acc.get(k)!r})"
+                        f" — the committed evidence no longer shows the "
+                        f"win; re-run serve-bench --generate --speculate")
+    # cross-check: the win boolean must agree with the recorded rows —
+    # the BEST greedy speculation arm strictly beats the gamma=0 arm
+    greedy = p["arms"]["greedy"]
+    base = greedy[0]["tokens_per_s"]
+    best = max(r["tokens_per_s"] for r in greedy[1:])
+    if not best > base:
+        rc |= _fail("spec_tokens_win contradicts the recorded "
+                    f"tokens_per_s (best spec {best} vs g0 {base})")
+    if rc == 0:
+        print(f"check_gen_artifacts: "
+              f"{os.path.relpath(path, REPO)} OK "
+              f"(greedy {base} -> {best} tok/s, accept "
+              f"{greedy[1].get('accept_rate')})")
+    return rc
+
+
 def check_pallas_decisions() -> int:
     rc = 0
     paths = sorted(glob.glob(os.path.join(REPO, "artifacts",
@@ -166,6 +237,7 @@ def main(argv=None) -> int:
     if "--pallas-only" in argv:
         return check_pallas_decisions()
     rc = check_prefix_bench()
+    rc |= check_spec_bench()
     rc |= check_pallas_decisions()
     return rc
 

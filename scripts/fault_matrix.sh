@@ -28,10 +28,12 @@ declare -a cases=(
   # dispatcher (docs/serving.md "Overload, SLOs & degradation";
   # in-process, injectable clock/sleep — tier-1 speed)
   "$FAST_TIMEOUT tests/test_serving.py::TestServeFaults"
-  # serve_cancel_at_token / serve_slow_decode: the token-generation
-  # fault kinds driven through the GenerationEngine's decode loop
-  # (docs/serving.md "Token generation"; a mid-generation cancel must
-  # free its KV slot and fail only its own stream)
+  # serve_cancel_at_token / serve_slow_decode / spec_draft_fail: the
+  # token-generation fault kinds driven through the GenerationEngine's
+  # decode loop (docs/serving.md "Token generation"; a mid-generation
+  # cancel must free its KV slot and fail only its own stream, and an
+  # injected draft failure must demote speculation to plain decode
+  # without failing ANY stream)
   "$FAST_TIMEOUT tests/test_generation.py::TestGenerationFaults"
   # fleet_load_fail / fleet_swap_at_dispatch: the model-fleet fault
   # kinds — a failed background load must leave serving tenants

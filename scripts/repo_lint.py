@@ -115,6 +115,16 @@ by tier-1 ``tests/test_static_checks.py``).  Rules:
   A non-literal name needs an ``RL011-ok:`` comment naming the
   literals it can resolve to (each declared).  ``fflogger.py`` (the
   definition site) and tests/scripts are out of scope.
+* **RL014 — no unseeded RNG in serving code** (ISSUE 16): sampling in
+  ``flexflow_tpu/serving/`` must be deterministic per (seed, request)
+  — the whole reproducibility contract of the sampled decode path.
+  Two leaks break it: a global-state ``np.random.<draw>()`` (use
+  ``np.random.default_rng(seed)`` or the request's
+  ``SamplingParams.seed``), and a ``jax.random.PRNGKey(...)`` whose
+  argument is derived from wall-clock or process entropy
+  (``time.time``/``time.monotonic``/``os.urandom``/``os.getpid``) —
+  a key that differs between two identical runs.  The rare
+  deliberate site carries an ``RL014-ok:`` comment.
 
 Exit 0 when clean, 1 with ``file:line: RLxxx message`` findings on
 stdout.  No third-party deps — must run on a bare CPython.
@@ -393,6 +403,7 @@ class _Visitor(ast.NodeVisitor):
             self._check_savez(node, name)
             self._check_warn(node, name)
             self._check_rng(node, name)
+            self._check_serving_rng(node, name)
             self._check_step_sync(node, name)
             self._check_raw_mesh(node, name)
             self._check_clock(node, name)
@@ -667,6 +678,47 @@ class _Visitor(ast.NodeVisitor):
             self._add(node, "RL003",
                       f"unseeded global-state {name}() in a test — use "
                       f"random.Random(seed)")
+
+    # RL014: entropy sources that make a PRNG key differ between two
+    # identical serving runs
+    _RL014_ENTROPY = {"time.time", "time.monotonic", "time.time_ns",
+                      "time.perf_counter", "os.urandom", "os.getpid",
+                      "uuid.uuid4", "secrets.token_bytes"}
+
+    def _check_serving_rng(self, node: ast.Call, name: str) -> None:
+        """RL014: serving code (the sampled decode path above all) must
+        be deterministic per (seed, request) — no global-state numpy
+        draws, no wall-clock/entropy-derived jax PRNG keys."""
+        if not self.in_serving:
+            return
+        parts = name.split(".")
+        if parts[:2] in (["np", "random"], ["numpy", "random"]) \
+                and len(parts) == 3 and parts[2] not in _NP_RANDOM_OK:
+            if "RL014-ok" not in self.lines[node.lineno - 1]:
+                self._add(node, "RL014",
+                          f"unseeded global-state {name}() in serving "
+                          f"code — sampled decode must be deterministic "
+                          f"per (seed, request); use np.random."
+                          f"default_rng(seed) or the request's "
+                          f"SamplingParams.seed")
+            return
+        if parts[-1] != "PRNGKey" and name != "PRNGKey":
+            return
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            for sub in ast.walk(arg):
+                if not isinstance(sub, ast.Call):
+                    continue
+                src = _dotted(sub.func)
+                if src in self._RL014_ENTROPY:
+                    if "RL014-ok" in self.lines[node.lineno - 1]:
+                        return
+                    self._add(node, "RL014",
+                              f"PRNG key seeded from {src}() in serving "
+                              f"code — the key differs between two "
+                              f"identical runs, breaking per-(seed, "
+                              f"request) reproducibility; derive keys "
+                              f"from SamplingParams.seed")
+                    return
 
 
 def lint_file(path: str) -> List[str]:

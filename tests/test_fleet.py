@@ -85,6 +85,38 @@ def test_fleet_json_schema_validation():
     assert any("generation" in p for p in probs)
 
 
+def test_fleet_json_draft_schema():
+    """ISSUE 16 schema half: a generation tenant's ``draft`` reference
+    must resolve INSIDE the file to an engine='draft' entry, and draft
+    entries serve no traffic of their own."""
+    gen = {"name": "chat", "model": "transformer_lm",
+           "engine": "generation"}
+    # dangling reference
+    probs = validate_fleet_json({"fleet": [
+        {**gen, "generation": {"draft": "tiny"}}]})
+    assert any("draft" in p and "tiny" in p for p in probs)
+    # reference to a non-draft tenant
+    probs = validate_fleet_json({"fleet": [
+        {**gen, "generation": {"draft": "other"}},
+        {"name": "other", "model": "transformer_lm",
+         "engine": "generation"}]})
+    assert any("engine 'draft'" in p for p in probs)
+    # non-string reference
+    probs = validate_fleet_json({"fleet": [
+        {**gen, "generation": {"draft": 3}}]})
+    assert any("must name" in p for p in probs)
+    # draft entries take no serve/generation sections
+    probs = validate_fleet_json({"fleet": [
+        {"name": "tiny", "model": "transformer_lm", "engine": "draft",
+         "generation": {"slots": 2}}]})
+    assert any("draft" in p for p in probs)
+    # and a well-formed pairing passes
+    ok = {"fleet": [
+        {**gen, "generation": {"draft": "tiny", "spec_gamma": 2}},
+        {"name": "tiny", "model": "transformer_lm", "engine": "draft"}]}
+    assert validate_fleet_json(ok) == []
+
+
 def test_registry_from_json_unknown_model_loud():
     with pytest.raises(ValueError, match="unknown model"):
         ModelRegistry.from_json(
@@ -340,6 +372,81 @@ def test_gate_matches_engine_allocations_byte_for_byte():
             real = fleet.stats(name)["resident_bytes"]
             assert real == predicted[name], (
                 name, real, predicted[name])
+
+
+def _draft_registry():
+    """A generation tenant with a co-registered speculative draft —
+    the SAME builder (identical weights) so greedy windows accept."""
+    reg = ModelRegistry()
+    reg.register("chat", _lm_builder, engine="generation", batch_size=2,
+                 generation={"slots": 2, "max_seq": 32,
+                             "max_new_tokens": 4, "stats_every": 0,
+                             "draft": "tiny", "spec_gamma": 2})
+    reg.register("tiny", _lm_builder, engine="draft", batch_size=2)
+    return reg
+
+
+def test_gate_charges_draft_onto_target_byte_for_byte():
+    """ISSUE 16 gate pin: the draft tenant's params + its own KV page
+    pool are charged onto the REFERENCING generation tenant, and the
+    prediction equals the fleet engine's real per-device allocation
+    exactly.  The draft never becomes a standalone tenant — and the
+    co-hosted pair still emits exactly the plain engine's tokens."""
+    reg = _draft_registry()
+    model, strategies = reg.graph("chat")
+    dmodel, dstrat = reg.graph("tiny")
+    row = model_residency(reg.spec("chat"), model.layers,
+                          model.input_tensors, strategies,
+                          model_config=model.config,
+                          draft=("tiny", dmodel.layers, dstrat))
+    assert row["draft"] == "tiny" and row["draft_bytes"] > 0
+    assert row["resident_bytes"] > row["params_bytes"] + row["kv_bytes"]
+
+    # the solo plain engine's tokens are the parity target (greedy
+    # speculation is bit-identical by the ISSUE 16 anchor)
+    cfg = ff.FFConfig(batch_size=2, compute_dtype="float32", seed=0)
+    solo = _lm_builder(cfg)
+    solo.compile(ff.SGDOptimizer(lr=0.01), mesh=MachineMesh({"n": 1}))
+    solo.init_layers(seed=0)
+    prompt = [3, 1, 4]
+    with silenced("serve"):
+        with GenerationEngine(solo, slots=2, max_new_tokens=4) as eng:
+            want = list(eng.submit(prompt))
+        with FleetEngine(reg) as fleet:
+            assert fleet.names() == ["chat"]  # no standalone draft row
+            real = fleet.stats("chat")["resident_bytes"]
+            assert real == row["resident_bytes"], (real, row)
+            got = list(fleet.submit("chat", prompt, max_new_tokens=4))
+            st = fleet.stats("chat")
+    assert got == want, (got, want)
+    assert st["draft_dispatches"] > 0 and st["spec_fallbacks"] == 0
+
+
+def test_fleet_gate_ff130_flips_with_draft():
+    """The acceptance flip: a budget that fits the generation tenant
+    alone overflows once its draft's params + pool are charged —
+    FF130 appears exactly on the with-draft run."""
+    reg = _draft_registry()
+    report, rows = fleet_gate_report(reg, hbm_gb=16.0)
+    assert [r["name"] for r in rows] == ["chat"]  # draft: no own row
+    assert report.codes().count("FF131") == 1
+    row = rows[0]
+    assert row["draft"] == "tiny" and row["draft_bytes"] > 0
+
+    no_draft = ModelRegistry()
+    no_draft.register("chat", _lm_builder, engine="generation",
+                      batch_size=2,
+                      generation={"slots": 2, "max_seq": 32,
+                                  "max_new_tokens": 4,
+                                  "stats_every": 0})
+    _, rows0 = fleet_gate_report(no_draft, hbm_gb=16.0)
+    # a budget between (target alone) and (target + draft)
+    budget_gb = (rows0[0]["ff108_bytes"]
+                 + row["draft_bytes"] / 2) / 1e9
+    rep_with, _ = fleet_gate_report(reg, hbm_gb=budget_gb)
+    rep_without, _ = fleet_gate_report(no_draft, hbm_gb=budget_gb)
+    assert "FF130" in rep_with.codes()
+    assert "FF130" not in rep_without.codes()
 
 
 def test_lint_fleet_rejects_over_hbm_and_passes_minus_one(tmp_path):

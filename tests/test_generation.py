@@ -21,6 +21,7 @@ import jax.numpy as jnp
 
 import flexflow_tpu as ff
 from flexflow_tpu import faults
+from flexflow_tpu.fflogger import capture_events
 from flexflow_tpu.op import OpContext
 from flexflow_tpu.ops.attention import MultiHeadAttention, PositionEmbedding
 from flexflow_tpu.ops.rnn import LSTM
@@ -28,7 +29,8 @@ from flexflow_tpu.parallel.mesh import MachineMesh
 from flexflow_tpu.serving.errors import (DeadlineExceeded,
                                          GenerationCancelled,
                                          OverloadError, SheddedError)
-from flexflow_tpu.serving.generation import GenerationEngine, GraphDecoder
+from flexflow_tpu.serving.generation import (GenerationEngine,
+                                             GraphDecoder, SamplingParams)
 from flexflow_tpu.tensor import Tensor
 
 VOCAB = 61
@@ -851,6 +853,33 @@ class TestGenerationFaults:
             assert (list(int(t) for t in ok.result(timeout=120))
                     == reference_decode(lm, prompts[1], 6))
 
+    def test_spec_draft_fail_demotes_without_failing_streams(
+            self, arm, lm, draft_lm, prompts):
+        """``FF_FAULT=spec_draft_fail:N``: the Nth draft dispatch
+        raises — the engine demotes to plain decode (ONE serve_health
+        fallback event, reason draft_error), NO stream fails, and
+        every token still equals the non-speculative reference."""
+        arm("spec_draft_fail:2")
+        refs = [reference_decode(lm, p, 6) for p in prompts[:3]]
+        eng = GenerationEngine(lm, slots=2, draft_model=draft_lm,
+                               spec_gamma=2)
+        with capture_events("serve") as events, eng:
+            streams = [eng.submit(p, max_new_tokens=6)
+                       for p in prompts[:3]]
+            outs = [list(int(t) for t in s.result(timeout=120))
+                    for s in streams]
+            snap = eng.stats()
+        assert outs == refs
+        assert snap["spec"] == "fallback"
+        assert snap["spec_fallbacks"] == 1
+        assert snap["errors"] == 0 and snap["cancelled"] == 0
+        ev = [e for e in events if e["event"] == "serve_health"
+              and e.get("component") == "speculation"]
+        assert len(ev) == 1
+        assert ev[0]["status"] == "fallback"
+        assert ev[0]["reason"] == "draft_error"
+        assert "spec_draft_fail" in ev[0]["error"]
+
 
 # ---------------------------------------------------------------------
 # bench harness smoke (the artifact generator)
@@ -874,3 +903,304 @@ def test_generate_bench_smoke():
     for row in (payload["continuous"], payload["static"]):
         assert "device_kind" in row and "comm_plan_digest" in row
         assert "calibration_digest" in row
+
+
+# ---------------------------------------------------------------------
+# speculative decoding + real sampling (ISSUE 16)
+# ---------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def draft_lm(lm):
+    # same seed as `lm` -> identical weights: the draft's greedy
+    # proposals all verify, so every window accepts (gamma-at-a-time)
+    return _build_lm()
+
+
+@pytest.fixture(scope="module")
+def draft_lm_off():
+    # a DIVERGENT draft (different init): proposals mostly reject, so
+    # the correction path carries the stream
+    return _build_lm(seed=7)
+
+
+def _run_spec(model, draft, prompts, max_new=6, sampling=None, **kw):
+    """Run one engine over `prompts` and return (token lists, stats).
+    `sampling` maps the prompt index to its SamplingParams."""
+    if draft is not None:
+        kw.setdefault("draft_model", draft)
+    eng = GenerationEngine(model, slots=2, **kw)
+    with eng:
+        streams = [eng.submit(p, max_new_tokens=max_new,
+                              sampling=(sampling(i) if sampling
+                                        else None))
+                   for i, p in enumerate(prompts)]
+        outs = [list(int(t) for t in s.result(timeout=180))
+                for s in streams]
+        snap = eng.stats()
+    return outs, snap
+
+
+@pytest.mark.parametrize("cache", ["on", "off"])
+@pytest.mark.parametrize("gamma", [2, 4])
+def test_spec_greedy_parity_bit_identical(lm, draft_lm, prompts, gamma,
+                                          cache):
+    """THE ISSUE 16 correctness anchor: greedy speculation is
+    BIT-IDENTICAL to the non-speculative engine (== the replicated
+    predict-style reference) at every gamma, prefix cache on and off —
+    speculation is a pure latency optimization, never a numerics
+    change."""
+    refs = [reference_decode(lm, p, 6) for p in prompts]
+    outs, snap = _run_spec(lm, draft_lm, prompts, max_new=6,
+                           spec_gamma=gamma, prefix_cache=cache)
+    assert outs == refs
+    assert snap["spec"] == "on" and snap["spec_fallbacks"] == 0
+    assert snap["draft_dispatches"] > 0
+    assert snap["spec_proposed_tokens"] > 0
+    # identical weights: the draft's argmax IS the target's argmax
+    assert snap["accept_rate"] == 1.0
+    assert snap["draft_kv_cache_bytes"] > 0
+
+
+def test_spec_divergent_draft_correction_parity(lm, draft_lm_off,
+                                                prompts):
+    """A draft that mostly DISAGREES with the target still yields
+    reference-exact tokens: rejected windows emit the target's
+    correction token, and the stream advances one-at-a-time."""
+    refs = [reference_decode(lm, p, 4) for p in prompts[:2]]
+    outs, snap = _run_spec(lm, draft_lm_off, prompts[:2], max_new=4,
+                           spec_gamma=4)
+    assert outs == refs
+    assert snap["accept_rate"] < 0.5  # divergent weights rarely agree
+    assert snap["spec"] == "on" and snap["spec_fallbacks"] == 0
+
+
+def test_spec_accept_collapse_demotes_to_plain(lm, draft_lm_off,
+                                               prompts, monkeypatch):
+    """The accept-collapse guard: a draft whose EWMA accept rate stays
+    under the floor is demoted to plain decode — one serve_health
+    fallback event, no failed streams, tokens still reference-exact."""
+    monkeypatch.setattr(GenerationEngine,
+                        "_SPEC_COLLAPSE_MIN_PROPOSED", 8)
+    monkeypatch.setattr(GenerationEngine, "_SPEC_COLLAPSE_ACCEPT", 0.9)
+    refs = [reference_decode(lm, p, 8) for p in prompts[:3]]
+    with capture_events("serve") as events:
+        outs, snap = _run_spec(lm, draft_lm_off, prompts[:3],
+                               max_new=8, spec_gamma=4)
+    assert outs == refs
+    assert snap["spec"] == "fallback" and snap["spec_fallbacks"] == 1
+    assert snap["errors"] == 0
+    ev = [e for e in events if e["event"] == "serve_health"
+          and e.get("component") == "speculation"]
+    assert len(ev) == 1
+    assert ev[0]["reason"] == "accept_collapse"
+    assert ev[0]["status"] == "fallback"
+    assert ev[0]["accept_ewma"] < 0.9
+
+
+def test_spec_eos_and_max_new_truncate_mid_window(lm, draft_lm,
+                                                  prompts):
+    """EOS and max_new under speculation truncate EXACTLY like the
+    plain engine, including when the stop lands mid-verify-window
+    (accepted tokens past the stop are discarded, never emitted)."""
+    ref = reference_decode(lm, prompts[0], 6)
+    eng = GenerationEngine(lm, slots=2, draft_model=draft_lm,
+                           spec_gamma=4, eos_id=int(ref[2]))
+    with eng:
+        out = list(int(t) for t in
+                   eng.submit(prompts[0], max_new_tokens=6)
+                   .result(timeout=180))
+    assert out == ref[:3]  # stops at (and includes) EOS, mid-window
+    # max_new that is not a multiple of the window: exact truncation
+    outs, _ = _run_spec(lm, draft_lm, prompts[:2], max_new=3,
+                        spec_gamma=4)
+    assert outs == [reference_decode(lm, p, 3) for p in prompts[:2]]
+
+
+def test_spec_adaptive_policy_parity(lm, draft_lm, prompts):
+    """The adaptive gamma controller changes WHEN tokens land, never
+    WHICH tokens: greedy parity holds while gamma retunes."""
+    outs, snap = _run_spec(lm, draft_lm, prompts[:3], max_new=8,
+                           spec_policy="adaptive", spec_gamma_max=4)
+    assert outs == [reference_decode(lm, p, 8) for p in prompts[:3]]
+    assert snap["spec"] == "on" and snap["draft_dispatches"] > 0
+    assert snap["spec_policy"] == "adaptive"
+    assert 2 <= snap["spec_gamma"] <= 4
+
+
+def test_sampled_decode_deterministic_and_temp0_greedy(lm, prompts):
+    """Real sampling is deterministic per (seed, request): the same
+    submission replays the same tokens run over run; temperature 0
+    through the sampled path IS greedy (exact one-hot, same argmax)."""
+    def sp(i):
+        return SamplingParams(temperature=0.8, top_k=8, top_p=0.9,
+                              seed=100 + i)
+    outs1, _ = _run_spec(lm, None, prompts[:3], max_new=6, sampling=sp)
+    outs2, _ = _run_spec(lm, None, prompts[:3], max_new=6, sampling=sp)
+    assert outs1 == outs2
+    outs0, _ = _run_spec(lm, None, prompts[:3], max_new=6,
+                         sampling=lambda i: SamplingParams(
+                             temperature=0.0, seed=5))
+    assert outs0 == [reference_decode(lm, p, 6) for p in prompts[:3]]
+    # distinct seeds genuinely sample distinct continuations
+    a, _ = _run_spec(lm, None, [prompts[0]], max_new=12,
+                     sampling=lambda i: SamplingParams(temperature=1.5,
+                                                       seed=1))
+    b, _ = _run_spec(lm, None, [prompts[0]], max_new=12,
+                     sampling=lambda i: SamplingParams(temperature=1.5,
+                                                       seed=2))
+    assert a != b
+
+
+def test_spec_sampled_reproducible(lm, draft_lm_off, prompts):
+    """Speculation + sampling: the rejection-sampling acceptance path
+    (draft q vs target p, per-request seeded keys) replays the same
+    tokens run over run."""
+    def sp(i):
+        return SamplingParams(temperature=0.8, seed=50 + i)
+    kw = dict(max_new=6, sampling=sp, spec_gamma=2)
+    outs1, snap1 = _run_spec(lm, draft_lm_off, prompts[:2], **kw)
+    outs2, snap2 = _run_spec(lm, draft_lm_off, prompts[:2], **kw)
+    assert outs1 == outs2
+    assert snap1["spec"] == "on" and snap1["draft_dispatches"] > 0
+    assert snap1["spec_fallbacks"] == 0
+
+
+def test_speculative_accept_preserves_target_distribution():
+    """The rejection-sampling exactness pin: tokens emitted through
+    draft -> accept -> residual are distributed as the TARGET p, not
+    the draft q — for the windowed kernel the engine dispatches AND
+    the single-position reference sampler, with the acceptance rate
+    matching sum(min(p, q))."""
+    from flexflow_tpu.serving.generation.sampling import (
+        speculative_accept, speculative_sample)
+    V, N = 8, 40000
+    rng = np.random.default_rng(0)
+    p = rng.dirichlet(np.ones(V)).astype(np.float32)
+    q = rng.dirichlet(np.ones(V)).astype(np.float32)
+    pj, qj = jnp.asarray(p), jnp.asarray(q)
+    kd, ka, kr = jax.random.split(jax.random.PRNGKey(42), 3)
+    d = jax.random.categorical(kd, jnp.log(qj), shape=(N,))[:, None]
+    accept_keys = jax.random.split(ka, N).reshape(N, 1, 2)
+    residual_keys = jax.random.split(kr, N).reshape(N, 1, 2)
+    P = jnp.broadcast_to(pj, (N, 1, V))
+    Q = jnp.broadcast_to(qj, (N, 1, V))
+    n_acc, out = speculative_accept(d, P, Q, accept_keys,
+                                    residual_keys)
+    emp = np.bincount(np.asarray(out)[:, 0], minlength=V) / N
+    assert 0.5 * np.abs(emp - p).sum() < 0.02          # TV distance
+    assert abs(float(jnp.mean(n_acc))
+               - float(np.minimum(p, q).sum())) < 0.02
+    # emitting from q would be FAR off: the test can actually fail
+    assert 0.5 * np.abs(p - q).sum() > 0.1
+    ref = np.asarray(speculative_sample(jax.random.PRNGKey(7), pj, qj,
+                                        N))
+    emp_ref = np.bincount(ref, minlength=V) / N
+    assert 0.5 * np.abs(emp_ref - p).sum() < 0.02
+
+
+def test_sharded_spec_matches_reference(tmp_path, lm, draft_lm,
+                                        prompts):
+    """Greedy speculation parity holds on the strategy-sharded engine
+    too: TP target + replicated co-hosted draft, tokens identical to
+    the replicated reference."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    pb = tmp_path / "gen_tp.pb"
+    _write_tp_strategy(pb)
+    m2 = _build_lm()  # same seed -> same init values as `lm`
+    eng = GenerationEngine.from_strategy(m2, str(pb), slots=4,
+                                         draft_model=draft_lm,
+                                         spec_gamma=2)
+    with eng:
+        outs = [list(int(t) for t in
+                     eng.submit(p, max_new_tokens=6).result(timeout=180))
+                for p in prompts[:3]]
+        snap = eng.stats()
+    assert outs == [reference_decode(lm, p, 6) for p in prompts[:3]]
+    assert snap["draft_dispatches"] > 0
+    assert snap["spec_fallbacks"] == 0
+
+
+def test_gen_stats_carry_spec_fields(lm, draft_lm, prompts):
+    """gen_stats/stats() gain the ISSUE 16 fields from the ONE metrics
+    plane; a plain engine reports spec='off' with zero spec traffic."""
+    _, snap = _run_spec(lm, draft_lm, prompts[:1], max_new=4,
+                        spec_gamma=2)
+    for key in ("spec", "spec_gamma", "spec_policy",
+                "draft_kv_cache_bytes", "draft_dispatches",
+                "spec_proposed_tokens", "spec_accepted_tokens",
+                "accept_rate", "spec_fallbacks"):
+        assert key in snap, key
+    assert snap["spec"] == "on" and snap["spec_gamma"] == 2
+    assert snap["spec_policy"] == "fixed"
+    assert snap["draft_kv_cache_bytes"] > 0
+    _, snap0 = _run_spec(lm, None, prompts[:1], max_new=4)
+    assert snap0["spec"] == "off"
+    assert snap0["draft_dispatches"] == 0
+    assert snap0["draft_kv_cache_bytes"] == 0
+
+
+def test_spec_config_validation(lm, draft_lm):
+    with pytest.raises(ValueError, match=">= 2"):
+        GenerationEngine(lm, slots=2, draft_model=draft_lm,
+                         spec_gamma=1)
+    with pytest.raises(ValueError, match="spec_policy"):
+        GenerationEngine(lm, slots=2, draft_model=draft_lm,
+                         spec_gamma=2, spec_policy="bogus")
+    with pytest.raises(ValueError, match="speculation is off"):
+        GenerationEngine(lm, slots=2, draft_model=draft_lm,
+                         spec_gamma=0)
+    with pytest.raises(ValueError, match="spec_gamma_max"):
+        GenerationEngine(lm, slots=2, draft_model=draft_lm,
+                         spec_gamma=4, spec_gamma_max=2)
+    # an uncompiled draft is caught before any pool is allocated
+    from flexflow_tpu.models import build_transformer_lm
+    cfg = ff.FFConfig(batch_size=4, compute_dtype="float32", seed=0)
+    fresh = build_transformer_lm(cfg, num_layers=1, d_model=32,
+                                 num_heads=2, d_ff=64, seq_len=SEQ,
+                                 vocab_size=VOCAB)[0]
+    with pytest.raises(AssertionError, match="draft model"):
+        GenerationEngine(lm, slots=2, draft_model=fresh, spec_gamma=2)
+    # LSTM graphs cannot speculate (no rollback-free attention cache)
+    from flexflow_tpu.models import build_lstm_lm
+    cfg2 = ff.FFConfig(batch_size=4, compute_dtype="float32", seed=5)
+    lstm = build_lstm_lm(cfg2, vocab_size=VOCAB, embed_dim=24,
+                         hidden_dim=24, num_layers=1, seq_len=SEQ)[0]
+    lstm.compile(ff.SGDOptimizer(lr=0.01), mesh=MachineMesh({"n": 1}))
+    lstm.init_layers(seed=5)
+    with pytest.raises(ValueError, match="attention"):
+        GenerationEngine(lstm, slots=2, draft_model=draft_lm,
+                         spec_gamma=2)
+    # SamplingParams validates its ranges up front
+    with pytest.raises(ValueError, match="temperature"):
+        SamplingParams(temperature=-1.0)
+    with pytest.raises(ValueError, match="top_p"):
+        SamplingParams(top_p=0.0)
+
+
+# slow: the sweep runs 4 arms x {greedy, sampled} x 2 (warm + measure)
+# = 16 engine lifecycles (~20 s on 1 CPU); tier-1 keeps the budget, the
+# committed artifact's schema + acceptance stay gated every run by
+# scripts/check_gen_artifacts.py
+@pytest.mark.slow
+def test_spec_bench_smoke():
+    from flexflow_tpu.fflogger import silenced
+    from flexflow_tpu.serving.generation.bench import run_spec_bench
+    with silenced("ff", "serve"):
+        p = run_spec_bench(requests=4, slots=2, max_seq=64,
+                           prompt_lo=2, prompt_hi=6, new_tokens=6,
+                           d_model=32, num_heads=2, num_layers=2,
+                           draft_layers=1, seed=0, gamma_max=4,
+                           temperature=0.8)
+    assert p["bench"] == "gen-spec"
+    # the deterministic acceptance halves must hold at any scale (the
+    # timing half — spec_tokens_win — is asserted on the committed
+    # full-size artifact by scripts/check_gen_artifacts.py)
+    assert p["acceptance"]["greedy_parity"]
+    assert p["acceptance"]["sampled_reproducible"]
+    for mode in ("greedy", "temperature"):
+        rows = p["arms"][mode]
+        assert rows[0]["arm"] == "g0"
+        assert [r["arm"] for r in rows[1:]] == ["g2", "g4", "adaptive"]
+        assert all(r["tokens_per_s"] > 0 for r in rows)
+    assert "device_kind" in p and "comm_plan_digest" in p
+    assert p["config"]["draft"].startswith("weight-shared")

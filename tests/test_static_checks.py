@@ -446,6 +446,41 @@ def test_static_checks_script_passes_on_repo():
      "import numpy as np\n\ndef f(n, s, d):\n"
      "    return np.zeros((n, s, d), np.float32)\n",
      None),
+    # RL014: unseeded RNG in serving code breaks the per-(seed,
+    # request) sampling-determinism contract (ISSUE 16)
+    ("flexflow_tpu/serving/zz_bad_np_random.py",
+     "import numpy as np\n\ndef f():\n    return np.random.rand()\n",
+     "RL014"),
+    # (os.getpid, not time.time, keeps the pin orthogonal to RL008's
+    # injected-clock rule, which also covers serving wall-clock reads)
+    ("flexflow_tpu/serving/generation/zz_bad_pid_key.py",
+     "import os\nimport jax\n\ndef f():\n"
+     "    return jax.random.PRNGKey(os.getpid())\n",
+     "RL014"),
+    ("flexflow_tpu/serving/zz_bad_urandom_key.py",
+     "import os\nimport jax\n\ndef f():\n"
+     "    return jax.random.PRNGKey(\n"
+     "        int.from_bytes(os.urandom(4), 'little'))\n",
+     "RL014"),
+    # seeded forms are the sanctioned spelling
+    ("flexflow_tpu/serving/zz_ok_seeded_rng.py",
+     "import numpy as np\n\ndef f(seed):\n"
+     "    return np.random.default_rng(seed).random()\n",
+     None),
+    ("flexflow_tpu/serving/generation/zz_ok_seeded_key.py",
+     "import jax\n\ndef f(seed):\n"
+     "    return jax.random.PRNGKey(seed)\n",
+     None),
+    # the waiver comment admits the rare legitimate site
+    ("flexflow_tpu/serving/zz_ok_waived_rng.py",
+     "import os\nimport jax\n\ndef f():\n"
+     "    return jax.random.PRNGKey(os.getpid())"
+     "  # RL014-ok: per-process jitter\n",
+     None),
+    # outside serving/ the rule does not engage
+    ("flexflow_tpu/zz_ok_rng_outside_serving.py",
+     "import numpy as np\n\ndef f():\n    return np.random.rand()\n",
+     None),
     # RL012: jnp.dtype() resolution in an op module bypasses the ONE
     # precision-resolution point (ops/common.py)
     ("flexflow_tpu/ops/zz_bad_dtype_call.py",
