@@ -63,6 +63,16 @@ CODES: Dict[str, tuple] = {
     "FF140": (Severity.ERROR,
               "precision override on an fp32-pinned op (loss/norm stats)"),
     "FF141": (Severity.INFO, "per-op precision policy summary"),
+    # concurrency passes (ISSUE 18, analysis/concurrency.py "fflock")
+    "FF150": (Severity.ERROR,
+              "shared field accessed outside its inferred/declared guard"),
+    "FF151": (Severity.ERROR,
+              "lock-order inversion (cycle in the static lock graph)"),
+    "FF152": (Severity.WARN, "blocking call while holding a lock"),
+    "FF153": (Severity.WARN,
+              "cv.wait without predicate loop or without its lock"),
+    "FF154": (Severity.ERROR,
+              "annotation drift (# guarded_by: disagrees with inference)"),
 }
 
 

@@ -207,6 +207,11 @@ def lint_main(argv) -> int:
                              "does (docs/strategy_search.md)")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable report on stdout")
+    parser.add_argument("--concurrency", action="store_true",
+                        help="run the whole-program lock-discipline "
+                             "pass (FF150-FF154, docs/concurrency.md) "
+                             "over flexflow_tpu/ instead of a "
+                             "model/strategy lint")
     parser.add_argument("--no-resharding", action="store_true",
                         help="skip the FF109 hotspot report")
     parser.add_argument("--serve-slots", type=int, default=0,
@@ -227,12 +232,15 @@ def lint_main(argv) -> int:
                              "worst case slots x ceil(seq/page))")
     args = parser.parse_args(argv)
 
+    if args.concurrency:
+        from .analysis.concurrency import concurrency_main
+        return concurrency_main(as_json=args.json)
     if args.fleet:
         return _lint_fleet(args)
     builders = _lint_builders()
     if args.model is None:
-        print("lint: --model is required (or --fleet for the "
-              "co-residency gate)", file=sys.stderr)
+        print("lint: --model is required (or --fleet / --concurrency "
+              "for the whole-tree gates)", file=sys.stderr)
         return 2
     if args.model not in builders:
         print(f"lint: unknown model {args.model!r} (have "

@@ -25,6 +25,8 @@ import threading
 import time
 from typing import Any, Callable, Dict
 
+from .obs import lockwatch
+
 _LEVELS = {"debug": 10, "info": 20, "warning": 30, "error": 40, "none": 100}
 _DEFAULT_LEVEL = "info"
 
@@ -96,7 +98,11 @@ class Category:
                 muted = muted or mute
         for tap in taps:
             # passive observers (the obs.flight ring): mute-agnostic,
-            # and a broken tap must never take the emitting path down
+            # and a broken tap must never take the emitting path down.
+            # may-acquire: FlightRecorder._lock
+            # (the flight tap records into its ring under that lock —
+            # the contract puts the edge in the static fflock graph,
+            # since a stored callable is unresolvable)
             try:
                 tap(dict(rec))
             except Exception:  # noqa: BLE001
@@ -109,7 +115,7 @@ class Category:
 _registry: Dict[str, Category] = {}
 # guards _captures and _taps: entries are added/removed from producer
 # threads while Category.event iterates concurrently
-_capture_lock = threading.Lock()
+_capture_lock = lockwatch.lock("fflogger._capture_lock")
 # active capture_events contexts: (category-name filter | None, sink, mute)
 _captures: list = []  # guarded_by: _capture_lock
 # passive event observers: fn(record_dict), called for EVERY event

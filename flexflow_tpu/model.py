@@ -1204,7 +1204,8 @@ class FFModel:
                 import threading
                 # non-daemon: the interpreter joins it at exit, so a
                 # script whose last act is an async save still publishes
-                self._ckpt_writer = threading.Thread(target=guarded)
+                self._ckpt_writer = threading.Thread(
+                    target=guarded, name="ff-ckpt-writer")
                 self._ckpt_writer.start()
             else:
                 write()  # sync path: failures raise directly, untouched

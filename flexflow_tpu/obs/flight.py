@@ -26,6 +26,8 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from . import lockwatch
+
 DUMP_SCHEMA = "ff-flight-v1"
 ENV_DIR = "FF_FLIGHT_DIR"
 
@@ -40,7 +42,7 @@ class FlightRecorder:
     """Bounded ring of recent events + spans, dumpable on demand."""
 
     def __init__(self, capacity: int = 2048):
-        self._lock = threading.Lock()
+        self._lock = lockwatch.lock("FlightRecorder._lock")
         self._ring: deque = deque(maxlen=int(capacity))  # guarded_by: self._lock
         self._seq = 0                    # guarded_by: self._lock
         # both keyed (directory, reason) — see dump()'s limiter note
@@ -130,7 +132,7 @@ class FlightRecorder:
 
 
 _flight: Optional[FlightRecorder] = None
-_flight_lock = threading.Lock()
+_flight_lock = lockwatch.lock("flight._flight_lock")
 
 
 def get_flight() -> FlightRecorder:

@@ -27,6 +27,8 @@ import re
 import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from . import lockwatch
+
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 
@@ -58,6 +60,8 @@ class _Child:
     def __init__(self, lock: threading.Lock):
         self._lock = lock
         self._v = 0.0       # guarded_by: self._lock
+        # unguarded-ok: single atomic ref, published by set_fn and read
+        # lock-free by value() (a stale fn for one read is harmless)
         self._fn: Optional[Callable[[], float]] = None
 
     def inc(self, n: float = 1.0) -> None:
@@ -131,7 +135,7 @@ class _Family:
         self.help_text = help_text
         self.labelnames = labelnames
         self.buckets = tuple(sorted(float(b) for b in buckets))
-        self._lock = threading.Lock()
+        self._lock = lockwatch.lock("_Family._lock")
         self._children: Dict[Tuple[str, ...], object] = {}
 
     def labels(self, **labels: str):
@@ -145,9 +149,10 @@ class _Family:
         with self._lock:
             child = self._children.get(key)
             if child is None:
-                child = (_HistChild(threading.Lock(), self.buckets)
+                child = (_HistChild(lockwatch.lock("_HistChild._lock"),
+                                    self.buckets)
                          if self.kind == "histogram"
-                         else _Child(threading.Lock()))
+                         else _Child(lockwatch.lock("_Child._lock")))
                 self._children[key] = child
             return child
 
@@ -179,7 +184,7 @@ class MetricsRegistry:
     raises)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockwatch.lock("MetricsRegistry._lock")
         self._families: Dict[str, _Family] = {}  # guarded_by: self._lock
 
     def _declare(self, name: str, kind: str, help_text: str,
@@ -255,7 +260,7 @@ class MetricsRegistry:
 
 
 _registry: Optional[MetricsRegistry] = None
-_registry_lock = threading.Lock()
+_registry_lock = lockwatch.lock("registry._registry_lock")
 
 
 def get_registry() -> MetricsRegistry:

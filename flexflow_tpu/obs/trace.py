@@ -42,6 +42,8 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
+from . import lockwatch
+
 RAW_SCHEMA = "ff-trace-v1"
 CHROME_SCHEMA = "ff-chrome-trace-v1"
 
@@ -76,17 +78,19 @@ class Tracer:
     else happens only while tracing is on."""
 
     def __init__(self, capacity: int = 65536):
-        self.active = False          # lock-free hot-path gate
-        self.sample_rate = 0.0
-        self._lock = threading.Lock()
-        # bounded span ring (guarded_by: self._lock)
-        self._spans: deque = deque(maxlen=int(capacity))
+        self.active = False   # unguarded-ok: lock-free hot-path gate —
+        #   single bool, written under _lock, racy read only skips/keeps
+        #   one span
+        self.sample_rate = 0.0  # unguarded-ok: single float, same deal
+        self._lock = lockwatch.lock("Tracer._lock")
+        # bounded span ring
+        self._spans: deque = deque(maxlen=int(capacity))  # guarded_by: self._lock
         self._seq = 0      # guarded_by: self._lock
         self._acc = 0.0    # guarded_by: self._lock (systematic sampler)
         self._dropped = 0  # guarded_by: self._lock
-        # passive sinks (the flight recorder's tap): called with each
-        # finished span dict, outside the lock
-        self._sinks: List[Callable[[Dict], None]] = []
+        # passive sinks (the flight recorder's tap): mutated/snapshot
+        # under the lock, CALLED outside it
+        self._sinks: List[Callable[[Dict], None]] = []  # guarded_by: self._lock
 
     # ---- configuration -------------------------------------------------
     def configure(self, sample_rate: Optional[float] = None,
@@ -210,7 +214,7 @@ class Tracer:
 
 
 _tracer: Optional[Tracer] = None
-_tracer_lock = threading.Lock()
+_tracer_lock = lockwatch.lock("trace._tracer_lock")
 
 
 def get_tracer() -> Tracer:

@@ -51,6 +51,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from ..obs import lockwatch
 from .errors import DeadlineExceeded, OverloadError, SheddedError
 
 ADMISSION_POLICIES = ("block", "reject", "shed_oldest")
@@ -181,7 +182,7 @@ class MicroBatcher:
         self.admission = admission
         self.starvation_s = float(starvation_ms) / 1e3
         self.clock = clock
-        self._cv = threading.Condition()
+        self._cv = lockwatch.condition("MicroBatcher._cv")
         # priority class -> FIFO deque (ONE class 0 deque in the default
         # path — identical semantics to the plain FIFO this replaced)
         self._classes: Dict[int, deque] = {}  # guarded_by: self._cv

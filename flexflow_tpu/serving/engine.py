@@ -45,6 +45,7 @@ import numpy as np
 from .. import faults
 from ..compile_cache import enable as _enable_compile_cache
 from ..fflogger import get_logger
+from ..obs import lockwatch
 from ..obs.flight import flight_dump, get_flight
 from ..obs.trace import phase_of, tracer_from_config
 from .batcher import (ADMISSION_POLICIES, MicroBatcher, Request, bucket_for,
@@ -100,7 +101,7 @@ class _Join:
         # trace_done(phase, now): records the logical request's ONE
         # terminal span (None when the request was not sampled)
         self.trace_done = trace_done
-        self.lock = threading.Lock()
+        self.lock = lockwatch.lock("_Join.lock")
 
     def part(self, i: int) -> Callable:
         def on_done(out, now: float) -> bool:
@@ -116,16 +117,24 @@ class _Join:
             if self.future.done():
                 return False
             if isinstance(out, BaseException):
-                if _resolve_future(self.future, out):
-                    self.metrics.record_failure(out)
-                    if self.trace_done is not None:
-                        self.trace_done(phase_of(out), now)
-                    return True
-                return False
-            self.parts[i] = out
-            self.missing -= 1
-            if self.missing:
-                return False
+                pass  # resolve OUTSIDE the lock, below
+            else:
+                self.parts[i] = out
+                self.missing -= 1
+                if self.missing:
+                    return False
+        # resolution (and the metrics/trace callbacks it triggers —
+        # done-callbacks run synchronously inside set_result/exception)
+        # happens outside _Join.lock: callbacks may take other locks,
+        # and _resolve_future's first-writer-wins keeps the
+        # counted-once invariant without holding ours
+        if isinstance(out, BaseException):
+            if _resolve_future(self.future, out):
+                self.metrics.record_failure(out)
+                if self.trace_done is not None:
+                    self.trace_done(phase_of(out), now)
+                return True
+            return False
         if _resolve_future(self.future,
                            np.concatenate(self.parts, axis=0)):
             self.metrics.record_request(now - self.t_submit,
@@ -256,12 +265,12 @@ class ServingEngine:
         self._degraded_after_errors = int(degraded_after_errors)
         self._degraded_drop_frac = float(degraded_drop_frac)
         self._last_health = "starting"  # guarded_by: self._health_lock
-        self._health_lock = threading.Lock()
+        self._health_lock = lockwatch.lock("ServingEngine._health_lock")
         # final serve_stats emitted exactly once
         self._finalized = False  # guarded_by: self._lifecycle
         self._shutdown_done = threading.Event()
         self._serve_faults: List[Dict] = []
-        self._lifecycle = threading.Lock()
+        self._lifecycle = lockwatch.lock("ServingEngine._lifecycle")
 
     # ---- health state machine ------------------------------------------
     @property
@@ -352,10 +361,14 @@ class ServingEngine:
         deadlock).  The engine is single-use — see start().  For a
         BOUNDED drain that fails stragglers instead of waiting them
         out, see :meth:`drain`."""
+        to_fail: List[Request] = []
+        err = now = None
         with self._lifecycle:
             self._stopped = True
             self._batcher.close()
             if self._thread is not None:
+                # lock-ok: dispatcher never takes _lifecycle, so joining
+                # it under the lock cannot deadlock (see docstring)
                 self._thread.join()
                 self._thread = None
                 if not self._finalized:
@@ -382,8 +395,13 @@ class ServingEngine:
                     reqs = self._batcher.poll()
                     if not reqs:
                         break
-                    for r in reqs:
-                        r.on_done(err, now)
+                    to_fail.extend(reqs)
+        # fail the evicted requests OUTSIDE _lifecycle: on_done
+        # resolves futures, and their done-callbacks take _Join /
+        # metrics / tracer locks the static lock graph cannot see
+        # through a stored callable
+        for r in to_fail:
+            r.on_done(err, now)
         self._health_tick()
         # retire the live registry hooks: a stopped engine must not be
         # retained by the process-global registry (fleet swaps, bench

@@ -46,6 +46,7 @@ from typing import Callable, Dict, List, Optional
 
 from ... import faults
 from ...fflogger import get_logger
+from ...obs import lockwatch
 from ..engine import ServingEngine
 from ..generation.engine import GenerationEngine
 from .registry import ModelRegistry, TenantSpec, build_model
@@ -177,7 +178,7 @@ class FleetEngine:
         self.clock = clock
         self._sleep = sleep
         self.stats_every_s = float(stats_every_s)
-        self._lock = threading.Lock()
+        self._lock = lockwatch.lock("FleetEngine._lock")
         self._tenants: Dict[str, _Tenant] = {}  # guarded_by: self._lock
         # swapped-out GENERATION tenants still holding active decode
         # slots: the dispatcher keeps stepping them (admission closed,

@@ -360,7 +360,8 @@ def _stall_once(model, slots: int, max_seq: int, chunk: int,
                 last = now
                 got.set()
 
-        th = threading.Thread(target=consume, daemon=True)
+        th = threading.Thread(target=consume, daemon=True,
+                              name="ff-genbench-consume")
         th.start()
         got.wait(timeout=60)  # victim is decoding before the joins
         t0 = time.perf_counter()

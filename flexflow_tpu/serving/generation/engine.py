@@ -62,6 +62,7 @@ import numpy as np
 from ... import faults
 from ...compile_cache import enable as _enable_compile_cache
 from ...fflogger import get_logger
+from ...obs import lockwatch
 from ...obs.flight import flight_dump, get_flight
 from ...obs.trace import phase_of, tracer_from_config
 from ...profiling import quantiles
@@ -645,7 +646,7 @@ class GenerationEngine:
         self._stopped = False    # guarded_by: self._lifecycle
         self._draining = False   # guarded_by: self._lifecycle
         self._finalized = False  # guarded_by: self._lifecycle
-        self._lifecycle = threading.Lock()
+        self._lifecycle = lockwatch.lock("GenerationEngine._lifecycle")
         self._closing = threading.Event()
         self._abort = threading.Event()
         self._shutdown_done = threading.Event()
@@ -760,10 +761,14 @@ class GenerationEngine:
         completion, stop the dispatcher, emit final stats.  Idempotent;
         single-use (see start()).  For a BOUNDED shutdown that sheds
         stragglers, see :meth:`drain`."""
+        to_fail: List[Request] = []
+        err = now = None
         with self._lifecycle:
             self._closing.set()
             self._batcher.close()
             if self._thread is not None:
+                # lock-ok: dispatcher never takes _lifecycle, so joining
+                # it under the lock cannot deadlock
                 self._thread.join()
                 self._thread = None
                 if not self._finalized:
@@ -774,9 +779,12 @@ class GenerationEngine:
                 now = self.clock()
                 err = SheddedError(
                     "engine stopped before it was started")
-                for r in self._batcher.fail_pending():
-                    r.on_done(err, now)
+                to_fail = self._batcher.fail_pending()
             self._stopped = True
+        # resolve OUTSIDE _lifecycle: on_done's future callbacks take
+        # locks the static graph cannot see through a stored callable
+        for r in to_fail:
+            r.on_done(err, now)
         # same registry retirement as ServingEngine.stop()
         self.metrics.release()
         self._shutdown_done.set()

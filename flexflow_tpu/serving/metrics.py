@@ -27,6 +27,7 @@ from collections import deque
 from typing import Callable, Dict, Optional, Tuple
 
 from ..fflogger import get_logger
+from ..obs import lockwatch
 from ..obs.registry import get_registry
 from ..obs.trace import phase_of
 from ..profiling import quantiles
@@ -35,7 +36,7 @@ from ..profiling import quantiles
 # two engines serving the SAME model name (bench legs, a fleet swap's
 # old/new generation) from merging their registry counters
 _ENG_SEQ = [0]
-_ENG_LOCK = threading.Lock()
+_ENG_LOCK = lockwatch.lock("metrics._ENG_LOCK")
 
 
 def next_engine_id() -> str:
@@ -141,7 +142,7 @@ class ServingMetrics:
             lambda: (self.queue_depth_fn() if self.queue_depth_fn
                      else 0))
         self._released = False
-        self._lock = threading.Lock()
+        self._lock = lockwatch.lock("ServingMetrics._lock")
         # every rolling-window structure and counter below is
         # guarded_by self._lock (RL009): records arrive from producer
         # threads AND the dispatcher concurrently

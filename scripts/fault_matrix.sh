@@ -45,6 +45,12 @@ declare -a cases=(
   # failed dispatch and retaining its request spans; a health edge
   # into `degraded` dumps too, and the flight CLI reads both
   "$FAST_TIMEOUT tests/test_obs.py::TestFlightFaults"
+  # tier-1 serving smoke under the lockwatch gate: a full bench
+  # round-trip through the ServingEngine whose runtime
+  # acquisition-order graph must come out acyclic and a subset of the
+  # static FF151 graph (asserted by the conftest session gate, which
+  # the FF_LOCKWATCH export below arms for every case here)
+  "$FAST_TIMEOUT tests/test_serving.py::test_serve_bench_smoke"
 )
 if [ "${1:-}" != "--fast-only" ]; then
   cases+=(
@@ -61,6 +67,12 @@ fi
 # compilation cache across cases instead of re-clearing it every time
 # (tests/conftest.py clears it per session by default)
 export FF_TEST_KEEP_CACHE=1
+
+# the dynamic lock-order gate (docs/concurrency.md): every case runs
+# with instrumented locks, and tests/conftest.py's session gate then
+# asserts the observed acquisition-order graph is acyclic and a
+# subset of the static FF151 graph
+export FF_LOCKWATCH=1
 
 fails=0
 for entry in "${cases[@]}"; do

@@ -375,9 +375,12 @@ class _Visitor(ast.NodeVisitor):
             "flexflow_tpu/serving/generation/")
         self.in_clock_scope = (self.in_serving
                                and relpath not in _RL008_EXEMPT)
-        # RL009 engages where the concurrency-heavy classes live (the
-        # ISSUE 9 scope): the serving stack and the elastic supervisor
+        # RL009 engages where the concurrency-heavy classes live: the
+        # serving stack (incl. generation/), the elastic supervisor and
+        # the observability plane (ISSUE 18 widened it to obs/ so the
+        # annotation lint covers the same ground fflock inference does)
         self.in_guard_scope = (self.in_serving
+                               or relpath.startswith("flexflow_tpu/obs/")
                                or relpath == "flexflow_tpu/parallel/"
                                               "elastic.py")
         self.is_mesh_factory = relpath == "flexflow_tpu/parallel/mesh.py"

@@ -31,6 +31,12 @@ fi
 echo "== repo lint (scripts/repo_lint.py) =="
 python scripts/repo_lint.py "$@" || rc=1
 
+# the fflock concurrency pass (docs/concurrency.md): whole-program
+# lockset inference + deadlock-order analysis over flexflow_tpu/ —
+# FF150/FF151/FF154 are ERRORs and fail the gate
+echo "== concurrency lint (lint --concurrency) =="
+python -m flexflow_tpu.cli lint --concurrency || rc=1
+
 # calibration artifacts must parse against their schema and carry a
 # digest matching their content (flexflow-tpu calibrate --check) —
 # covers the committed seed table and any artifacts/calib_*.json

@@ -65,3 +65,39 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "faults: fault-injection matrix (fast, supervisor-level; tier-1)")
+
+
+# ---------------------------------------------------------------------------
+# FF_LOCKWATCH=1 session gate (ISSUE 18, docs/concurrency.md): after the
+# whole suite ran with instrumented locks, assert (a) the observed
+# runtime acquisition-order graph is acyclic and (b) every runtime
+# nested-acquisition edge between LIBRARY locks appears in the static
+# FF151 graph — the static ⊇ runtime pin that makes fflock trustworthy.
+# Edges touching test-local lock names are ignored (unit tests mint
+# their own); lockwatch tests that fabricate cycles must reset().
+# ---------------------------------------------------------------------------
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lockwatch_session_gate():
+    yield
+    from flexflow_tpu.obs import lockwatch
+    if not lockwatch.enabled():
+        return
+    rep = lockwatch.report()
+    if not rep["edges"]:
+        return
+    from flexflow_tpu.analysis import concurrency as cz
+    an = cz.build()
+    roster = set(an.locks)
+    run_edges = {(e["src"], e["dst"]) for e in rep["edges"]
+                 if e["src"] in roster and e["dst"] in roster}
+    cycle = lockwatch.find_cycle(run_edges)
+    assert cycle is None, (
+        f"FF_LOCKWATCH: runtime lock-order cycle: {' -> '.join(cycle)}")
+    extra = sorted(run_edges - set(an.edges))
+    assert not extra, (
+        "FF_LOCKWATCH: runtime nested-acquisition edges missing from "
+        f"the static FF151 graph (run `flexflow-tpu lint "
+        f"--concurrency` and close the gap): {extra}")

@@ -1,0 +1,408 @@
+"""fflock — the whole-program lock-discipline pass (ISSUE 18).
+
+Three legs:
+
+* a seeded KNOWN-BAD corpus: one minimal class per FF150–FF154 code,
+  each pinned to fire with the exact ``corpus/<mod>.py:<line>`` site
+  payload (the stable-payload half of the acceptance criteria);
+* the zero-findings pin on the shipped tree — ``flexflow_tpu/`` lints
+  at zero FF150-series ERRORs, and the static lock-order graph is
+  acyclic;
+* lockwatch unit tests: edge recording, hold accounting, the ABBA
+  cycle detector, the disabled-mode passthrough and registry publish.
+"""
+
+import threading
+
+import pytest
+
+from flexflow_tpu.analysis import concurrency as cz
+from flexflow_tpu.obs import lockwatch
+
+# ---------------------------------------------------------------------------
+# the known-bad corpus (written to tmp_path/corpus by the fixture; line
+# numbers below are 1-based within each snippet)
+# ---------------------------------------------------------------------------
+
+_FF150_SRC = """\
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def a(self):
+        with self._lock:
+            self._n += 1
+
+    def b(self):
+        with self._lock:
+            self._n += 1
+
+    def c(self):
+        with self._lock:
+            self._n += 1
+
+    def d(self):
+        with self._lock:
+            self._n += 1
+
+    def bad(self):
+        return self._n
+"""
+_FF150_LINE = 26  # the unguarded read in bad()
+
+_FF151_SRC = """\
+import threading
+
+
+class ABBA:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def x(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def y(self):
+        with self._b:
+            with self._a:
+                pass
+"""
+
+_FF152_SRC = """\
+import threading
+import time
+
+
+class Sleeper:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def bad(self):
+        with self._lock:
+            time.sleep(0.1)
+"""
+_FF152_LINE = 11
+
+_FF153_SRC = """\
+import threading
+
+
+class Waiter:
+    def __init__(self):
+        self._cv = threading.Condition()
+
+    def bad(self):
+        with self._cv:
+            if True:
+                self._cv.wait()
+"""
+_FF153_LINE = 11
+
+_FF154_SRC = """\
+import threading
+
+
+class Drift:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._n = 0  # guarded_by: self._b
+
+    def p(self):
+        with self._a:
+            self._n += 1
+
+    def q(self):
+        with self._a:
+            self._n += 1
+
+    def r(self):
+        with self._a:
+            self._n += 1
+
+    def s(self):
+        with self._a:
+            self._n += 1
+"""
+
+_CORPUS = {
+    "ff150": _FF150_SRC, "ff151": _FF151_SRC, "ff152": _FF152_SRC,
+    "ff153": _FF153_SRC, "ff154": _FF154_SRC,
+}
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    root = tmp_path_factory.mktemp("fflock") / "corpus"
+    root.mkdir()
+    for name, src in _CORPUS.items():
+        (root / f"{name}.py").write_text(src)
+    an = cz.build(str(root))
+    return root, an
+
+
+def _with_code(an, code):
+    return [d for d in an.report if d.code == code]
+
+
+def test_ff150_unguarded_access_fires(corpus):
+    _, an = corpus
+    hits = _with_code(an, "FF150")
+    assert any(d.op == f"corpus/ff150.py:{_FF150_LINE}" for d in hits), \
+        [d.to_dict() for d in hits]
+    hit = next(d for d in hits
+               if d.op == f"corpus/ff150.py:{_FF150_LINE}")
+    assert "Counter._lock" in hit.message
+    assert str(hit.severity) == "ERROR"
+
+
+def test_ff151_lock_order_cycle_fires(corpus):
+    _, an = corpus
+    hits = _with_code(an, "FF151")
+    assert hits, an.report.render_text()
+    msg = hits[0].message
+    assert "ABBA._a" in msg and "ABBA._b" in msg
+    assert str(hits[0].severity) == "ERROR"
+    # the cycle is visible in the raw edge set too
+    edges = set(an.edges)
+    assert ("ABBA._a", "ABBA._b") in edges
+    assert ("ABBA._b", "ABBA._a") in edges
+
+
+def test_ff152_blocking_under_lock_fires(corpus):
+    _, an = corpus
+    hits = _with_code(an, "FF152")
+    assert any(d.op == f"corpus/ff152.py:{_FF152_LINE}"
+               and "Sleeper._lock" in d.message for d in hits), \
+        [d.to_dict() for d in hits]
+
+
+def test_ff153_wait_without_predicate_loop_fires(corpus):
+    _, an = corpus
+    hits = _with_code(an, "FF153")
+    assert any(d.op == f"corpus/ff153.py:{_FF153_LINE}" for d in hits), \
+        [d.to_dict() for d in hits]
+
+
+def test_ff154_annotation_drift_fires(corpus):
+    _, an = corpus
+    hits = _with_code(an, "FF154")
+    assert hits, an.report.render_text()
+    hit = hits[0]
+    assert hit.op == "corpus/ff154.py:8"  # the drifted declaration
+    assert "Drift._b" in hit.message and "Drift._a" in hit.message
+    assert str(hit.severity) == "ERROR"
+
+
+def test_each_code_fires_only_where_expected(corpus):
+    """No cross-talk: each corpus module trips only the codes it seeds
+    (FF150 legitimately also fires in the drift corpus — every access
+    there violates the DECLARED guard)."""
+    _, an = corpus
+    for code, mods in (("FF150", ("ff150", "ff154")),
+                       ("FF152", ("ff152",)),
+                       ("FF153", ("ff153",)),
+                       ("FF154", ("ff154",))):
+        for d in _with_code(an, code):
+            assert any(d.op.startswith(f"corpus/{m}.py:") for m in mods), \
+                f"{code} fired outside its module: {d.to_dict()}"
+
+
+# ---------------------------------------------------------------------------
+# the shipped tree: zero FF150-series ERRORs, acyclic static graph
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tree():
+    return cz.build()
+
+
+def test_shipped_tree_has_zero_concurrency_errors(tree):
+    assert not tree.report.errors, tree.report.render_text()
+
+
+def test_shipped_tree_static_graph_is_acyclic(tree):
+    assert lockwatch.find_cycle(set(tree.edges)) is None
+
+
+def test_shipped_tree_covers_known_locks(tree):
+    """The roster must keep naming the serving stack's load-bearing
+    locks — an analyzer regression that silently drops lock discovery
+    would otherwise pass the zero-findings pin vacuously."""
+    for lid in ("MicroBatcher._cv", "ServingEngine._lifecycle",
+                "GenerationEngine._lifecycle", "FleetEngine._lock",
+                "ServingMetrics._lock", "fflogger._capture_lock",
+                "_Family._lock", "Tracer._lock"):
+        assert lid in tree.locks, sorted(tree.locks)
+
+
+def test_waivers_are_honored(tmp_path):
+    """`# lock-ok:` silences FF152 at the site (the shipped joins in
+    ServingEngine.stop/GenerationEngine.stop rely on this)."""
+    root = tmp_path / "corpus"
+    root.mkdir()
+    (root / "waived.py").write_text(
+        "import threading\n"
+        "import time\n\n\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n\n"
+        "    def ok(self):\n"
+        "        with self._lock:\n"
+        "            time.sleep(0.1)  # lock-ok: test waiver\n")
+    an = cz.build(str(root))
+    assert not _with_code(an, "FF152"), an.report.render_text()
+
+
+# ---------------------------------------------------------------------------
+# lockwatch (the dynamic twin)
+# ---------------------------------------------------------------------------
+
+def test_lockwatch_disabled_returns_plain_primitives(monkeypatch):
+    monkeypatch.delenv("FF_LOCKWATCH", raising=False)
+    assert not lockwatch.enabled()
+    lk = lockwatch.lock("X.l")
+    cv = lockwatch.condition("X.cv")
+    # plain threading objects: no lockwatch wrapper attributes
+    assert not isinstance(lk, lockwatch._Watched)
+    assert not isinstance(cv, lockwatch._WatchedCondition)
+    with lk:
+        pass
+    with cv:
+        cv.notify_all()
+
+
+def test_lockwatch_records_edges_and_holds(monkeypatch):
+    monkeypatch.setenv("FF_LOCKWATCH", "1")
+    lockwatch.reset()
+    try:
+        a = lockwatch.lock("TA.l")
+        b = lockwatch.lock("TB.l")
+        with a:
+            with b:
+                pass
+        with b:  # same order again: count grows, no new edge
+            pass
+        rep = lockwatch.report()
+        edges = {(e["src"], e["dst"]) for e in rep["edges"]}
+        assert ("TA.l", "TB.l") in edges
+        assert ("TB.l", "TA.l") not in edges
+        e = next(x for x in rep["edges"]
+                 if (x["src"], x["dst"]) == ("TA.l", "TB.l"))
+        assert e["count"] == 1 and e["threads"] == ["MainThread"]
+        assert rep["holds"]["TA.l"]["count"] == 1
+        assert rep["holds"]["TB.l"]["count"] == 2
+        assert rep["cycle"] is None
+    finally:
+        lockwatch.reset()
+
+
+def test_lockwatch_detects_abba_cycle(monkeypatch):
+    """A deliberate ABBA interleaving (run sequentially so the test
+    itself cannot deadlock) must produce a cycle verdict."""
+    monkeypatch.setenv("FF_LOCKWATCH", "1")
+    lockwatch.reset()
+    try:
+        a = lockwatch.lock("TC.a")
+        b = lockwatch.lock("TC.b")
+
+        def leg_ab():
+            with a:
+                with b:
+                    pass
+
+        def leg_ba():
+            with b:
+                with a:
+                    pass
+
+        t1 = threading.Thread(target=leg_ab, name="ff-test-ab")
+        t1.start()
+        t1.join()
+        t2 = threading.Thread(target=leg_ba, name="ff-test-ba")
+        t2.start()
+        t2.join()
+        rep = lockwatch.report()
+        cyc = rep["cycle"]
+        assert cyc is not None and cyc[0] == cyc[-1]
+        assert {"TC.a", "TC.b"} <= set(cyc)
+        threads = {t for e in rep["edges"] for t in e["threads"]}
+        assert threads == {"ff-test-ab", "ff-test-ba"}
+    finally:
+        lockwatch.reset()
+
+
+def test_lockwatch_reentrant_rlock_adds_no_edge(monkeypatch):
+    monkeypatch.setenv("FF_LOCKWATCH", "1")
+    lockwatch.reset()
+    try:
+        r = lockwatch.rlock("TR.l")
+        with r:
+            with r:  # reentrant: must not create TR.l -> TR.l
+                pass
+        assert lockwatch.edges() == set()
+    finally:
+        lockwatch.reset()
+
+
+def test_lockwatch_condition_wait_roundtrip(monkeypatch):
+    monkeypatch.setenv("FF_LOCKWATCH", "1")
+    lockwatch.reset()
+    try:
+        cv = lockwatch.condition("TCV.cv")
+        done = []
+
+        def waiter():
+            with cv:
+                while not done:
+                    if not cv.wait(timeout=5.0):
+                        break
+        t = threading.Thread(target=waiter, name="ff-test-waiter")
+        t.start()
+        # let the waiter block, then wake it
+        import time
+        time.sleep(0.05)
+        with cv:
+            done.append(1)
+            cv.notify_all()
+        t.join(timeout=5.0)
+        assert not t.is_alive()
+        rep = lockwatch.report()
+        assert rep["holds"]["TCV.cv"]["count"] >= 2
+        assert rep["cycle"] is None
+    finally:
+        lockwatch.reset()
+
+
+def test_lockwatch_publish_renders_valid_exposition(monkeypatch):
+    monkeypatch.setenv("FF_LOCKWATCH", "1")
+    lockwatch.reset()
+    try:
+        from flexflow_tpu.obs.registry import (MetricsRegistry,
+                                               validate_prometheus_text)
+        a = lockwatch.lock("TP.a")
+        b = lockwatch.lock("TP.b")
+        with a:
+            with b:
+                pass
+        reg = MetricsRegistry()
+        lockwatch.publish(reg)
+        text = reg.render()
+        assert validate_prometheus_text(text) == [], text
+        assert 'ff_lock_acq_order_edge{src="TP.a",dst="TP.b"} 1' in text
+        assert 'ff_lock_hold_seconds_count{lock="TP.a"} 1' in text
+    finally:
+        lockwatch.reset()
+
+
+def test_find_cycle_on_plain_graphs():
+    assert lockwatch.find_cycle({("A", "B"), ("B", "C")}) is None
+    cyc = lockwatch.find_cycle({("A", "B"), ("B", "C"), ("C", "A")})
+    assert cyc is not None and cyc[0] == cyc[-1]
+    assert set(cyc) == {"A", "B", "C"}
