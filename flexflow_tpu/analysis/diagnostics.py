@@ -59,6 +59,11 @@ CODES: Dict[str, tuple] = {
     "FF130": (Severity.ERROR,
               "fleet co-residency: summed per-device memory exceeds HBM"),
     "FF131": (Severity.INFO, "fleet per-model residency breakdown"),
+    # disaggregated prefill/decode topology (ISSUE 19, serving/cluster)
+    "FF132": (Severity.ERROR,
+              "disagg topology: decode pool undersized for migrated "
+              "pages, page-geometry mismatch, or prefill with no "
+              "decode target"),
     # precision-axis passes (ISSUE 14)
     "FF140": (Severity.ERROR,
               "precision override on an fp32-pinned op (loss/norm stats)"),

@@ -88,7 +88,7 @@ def main(argv=None) -> None:
               "       flexflow-tpu search-bench [flags]\n"
               "       flexflow-tpu train-bench [flags]\n"
               "       flexflow-tpu serve-bench [--overload|--generate"
-              " [--prefix|--speculate]|--fleet] [flags]\n"
+              " [--prefix|--speculate]|--fleet|--disagg] [flags]\n"
               "       flexflow-tpu precision-bench [--out f.json]\n"
               "       flexflow-tpu calibrate [--out table.json | "
               "--check FILE...]\n"

@@ -82,6 +82,22 @@ Grammar (specs joined by ``;``, qualifiers by ``,``)::
                             swap lands, so swap-under-load tests are
                             deterministic
 
+    router kinds (consumed by the cluster FleetRouter —
+    :func:`router_faults`; docs/serving.md "Disaggregated
+    prefill/decode"):
+
+    migrate_fail_at:N       the Nth KV page migration handoff raises
+                            (fires once) — the source engine must fall
+                            back to CO-LOCATED decode (one serve_health
+                            fallback event) with NO stream failing
+    route_host_down:NAME    host NAME is marked down at the router's
+                            first routing decision — its tenants'
+                            queued requests drain to surviving hosts
+                            (requeue, never re-judged), in-flight
+                            streams finish where they run, and no new
+                            route/migration targets it.  The arg is the
+                            HOST NAME, not a step index.
+
     qualifiers: rank=R (fire only on rank R), attempt=A or attempt=*
                 (default attempt=0 — faults must not re-fire on the
                 restarted attempt or recovery could never be observed),
@@ -125,7 +141,8 @@ KINDS = ("kill_at_step", "hang_at_step", "corrupt_ckpt",
          "shrink_at_step", "serve_slow_dispatch", "serve_fail_dispatch",
          "serve_queue_spike", "serve_cancel_at_token",
          "serve_slow_decode", "spec_draft_fail", "fleet_load_fail",
-         "fleet_swap_at_dispatch")
+         "fleet_swap_at_dispatch", "migrate_fail_at",
+         "route_host_down")
 
 SERVE_KINDS = ("serve_slow_dispatch", "serve_fail_dispatch",
                "serve_queue_spike")
@@ -139,6 +156,12 @@ GENERATION_KINDS = ("serve_cancel_at_token", "serve_slow_decode",
 # model-fleet kinds (FleetEngine / fleet registry — docs/serving.md
 # "Model fleets"); disjoint from both sets above
 FLEET_KINDS = ("fleet_load_fail", "fleet_swap_at_dispatch")
+
+# disaggregated-serving router kinds (cluster.FleetRouter —
+# docs/serving.md "Disaggregated prefill/decode"); disjoint from every
+# set above so a plan mixing engine families drives each one's own
+# fire points only
+ROUTER_KINDS = ("migrate_fail_at", "route_host_down")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -214,8 +237,8 @@ def parse_faults(text: Optional[str]) -> List[FaultSpec]:
                 raise ValueError(
                     f"corrupt_ckpt arg must be a step number or "
                     f"'latest', got {arg!r} in {raw!r}")
-        elif kind == "fleet_load_fail":
-            pass  # the arg IS a model name — any non-empty string
+        elif kind in ("fleet_load_fail", "route_host_down"):
+            pass  # the arg IS a model/host name — any non-empty string
         elif not (arg.isdigit() or (arg[:1] == "-" and arg[1:].isdigit())):
             raise ValueError(
                 f"{kind} arg must be an integer, got {arg!r} in {raw!r}")
@@ -400,6 +423,18 @@ def fleet_faults() -> List[FaultSpec]:
     if not p:
         return []
     return [s for s in p if s.kind in FLEET_KINDS and _matches(s)]
+
+
+def router_faults() -> List[FaultSpec]:
+    """The FF_FAULT disaggregated-router specs matching this
+    rank/attempt, in plan order (empty without a plan).  The consumer
+    is the cluster ``FleetRouter``, which materializes firing state at
+    ``start()`` and consults it at routing/migration boundaries; this
+    module stays jax- and engine-free."""
+    p = plan()
+    if not p:
+        return []
+    return [s for s in p if s.kind in ROUTER_KINDS and _matches(s)]
 
 
 def serve_faults() -> List[FaultSpec]:

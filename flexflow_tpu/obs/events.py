@@ -62,6 +62,13 @@ EVENTS = {
     "fleet_retired": "swapped-out generation engine finished its last "
                      "in-flight stream and stopped",
     "fleet_drain": "FleetEngine.drain() began",
+    "fleet_autoscale": "autoscaler changed a tenant's weight from its "
+                       "rolling queue-depth window (old/new weight)",
+    # ---- serving (disaggregated cluster) ------------------------------
+    "router_start": "FleetRouter started fronting role-tagged hosts",
+    "router_host_down": "a host was marked down; its tenants' queued "
+                        "requests drained to surviving hosts",
+    "router_stop": "FleetRouter stopped (routes/migrations totals)",
     # ---- observability plane (this package) --------------------------
     "flight_dump": "flight recorder wrote a post-mortem dump "
                    "(reason + path)",

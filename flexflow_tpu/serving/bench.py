@@ -547,6 +547,12 @@ def main(argv=None) -> None:
         from .fleet.bench import main as fleet_main
         fleet_main([a for a in argv if a != "--fleet"])
         return
+    if "--disagg" in argv:
+        # disaggregated prefill/decode vs co-located chunked prefill
+        # (docs/serving.md "Disaggregated prefill/decode")
+        from .cluster.bench import main as disagg_main
+        disagg_main([a for a in argv if a != "--disagg"])
+        return
     ap = argparse.ArgumentParser(
         prog="flexflow-tpu serve-bench",
         description="serving-engine microbenchmark: shape-bucketed AOT "

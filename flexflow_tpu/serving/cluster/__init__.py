@@ -1,0 +1,13 @@
+"""flexflow_tpu.serving.cluster — disaggregated prefill/decode serving
+(docs/serving.md "Disaggregated prefill/decode").
+
+* :class:`FleetRouter` — a fleet-of-fleets front: requests route to a
+  prefill host picked from scraped ``gen_stats``/``fleet_stats`` load
+  signals, and at prefill completion the KV page chain migrates
+  (``pages.export_pages``/``import_pages``) to a decode-role host, so
+  decode engines dispatch nothing but decode steps.
+"""
+
+from .router import FleetRouter
+
+__all__ = ["FleetRouter"]

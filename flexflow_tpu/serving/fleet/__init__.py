@@ -11,12 +11,15 @@ mesh (docs/serving.md "Model fleets").
   (``flexflow-tpu lint --fleet``): does the fleet FIT on the HBM?
 """
 
+from .autoscale import TenantAutoscaler
 from .engine import FleetEngine
 from .gate import fleet_gate_report, model_residency, static_params_bytes
-from .registry import (ENGINE_KINDS, ModelRegistry, TenantSpec,
-                       build_model, builtin_builders, validate_fleet_json)
+from .registry import (ENGINE_KINDS, TENANT_ROLES, ModelRegistry,
+                       TenantSpec, build_model, builtin_builders,
+                       validate_fleet_json)
 
 __all__ = ["FleetEngine", "ModelRegistry", "TenantSpec",
+           "TenantAutoscaler",
            "fleet_gate_report", "model_residency", "static_params_bytes",
            "validate_fleet_json", "builtin_builders", "build_model",
-           "ENGINE_KINDS"]
+           "ENGINE_KINDS", "TENANT_ROLES"]

@@ -1,0 +1,93 @@
+"""Per-tenant autoscaling policy (ISSUE 19 satellite; the PR 12
+remainder) — grow a loaded tenant's weighted-fair share from its
+rolling queue-depth window, release it after the burst.
+
+The policy is deliberately a pure observer: the fleet dispatcher feeds
+it ``(tenant, queue_depth, current_weight, now)`` samples at its own
+boundary and applies whatever new weight the policy returns
+(``fleet_autoscale`` event per change).  It never touches engines or
+locks — all state is per-tenant deques on the INJECTED clock, so a
+fake-clock test drives the whole grow/decay cycle deterministically
+(RL008; tests/test_cluster.py).
+
+Semantics:
+
+* each tenant's samples older than ``window_s`` are dropped; the mean
+  depth over the surviving window is the load signal (a single spike
+  does not retrigger growth, a drained queue does not instantly decay);
+* mean depth >= ``high_depth`` → weight grows by ``grow`` (capped at
+  ``max_weight`` x the tenant's BASE weight — the weight it had when
+  first observed, so an operator-set 2.0 share scales around 2.0, not
+  around the fleet default);
+* mean depth <= ``low_depth`` → weight decays by the same factor back
+  toward (never below) the base — idling releases borrowed share at
+  the same rate it was granted;
+* decisions are paced at ``every_s`` per tenant so one burst yields a
+  bounded ramp, not a weight explosion within a single window.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Dict, Optional, Tuple
+
+
+class TenantAutoscaler:
+    """Rolling-window weight policy for :class:`~.engine.FleetEngine`
+    (pass as its ``autoscaler=``).  See the module docstring for the
+    grow/decay semantics."""
+
+    def __init__(self, window_s: float = 5.0, every_s: float = 1.0,
+                 high_depth: float = 4.0, low_depth: float = 0.5,
+                 grow: float = 1.5, max_scale: float = 8.0):
+        if window_s <= 0 or every_s <= 0:
+            raise ValueError("window_s/every_s must be > 0")
+        if grow <= 1.0:
+            raise ValueError(f"grow must be > 1.0, got {grow}")
+        if max_scale < 1.0:
+            raise ValueError(f"max_scale must be >= 1.0, got {max_scale}")
+        if low_depth >= high_depth:
+            raise ValueError("low_depth must be < high_depth")
+        self.window_s = float(window_s)
+        self.every_s = float(every_s)
+        self.high_depth = float(high_depth)
+        self.low_depth = float(low_depth)
+        self.grow = float(grow)
+        self.max_scale = float(max_scale)
+        # per-tenant: (samples deque of (t, depth), base weight,
+        # last decision time)
+        self._win: Dict[str, Deque[Tuple[float, float]]] = {}
+        self._base: Dict[str, float] = {}
+        self._last: Dict[str, float] = {}
+
+    def observe(self, name: str, depth: float, weight: float,
+                now: float) -> Optional[float]:
+        """Record one queue-depth sample; return the new weight when
+        the policy wants a change, else None.  Called by the fleet
+        dispatcher only — single-threaded by construction."""
+        base = self._base.setdefault(name, float(weight))
+        win = self._win.setdefault(name, deque())
+        win.append((now, float(depth)))
+        while win and win[0][0] < now - self.window_s:
+            win.popleft()
+        if now - self._last.get(name, -1e30) < self.every_s:
+            return None
+        mean = sum(d for _, d in win) / len(win)
+        new = None
+        if mean >= self.high_depth:
+            new = min(base * self.max_scale, weight * self.grow)
+        elif mean <= self.low_depth and weight > base:
+            new = max(base, weight / self.grow)
+        if new is None or abs(new - weight) < 1e-12:
+            return None
+        self._last[name] = now
+        return new
+
+    def forget(self, name: str) -> None:
+        """Drop a departed tenant's window/base (unload path)."""
+        self._win.pop(name, None)
+        self._base.pop(name, None)
+        self._last.pop(name, None)
+
+
+__all__ = ["TenantAutoscaler"]

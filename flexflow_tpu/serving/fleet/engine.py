@@ -172,12 +172,37 @@ class FleetEngine:
     def __init__(self, registry: Optional[ModelRegistry] = None,
                  mesh=None, stats_every_s: float = 10.0,
                  clock: Callable[[], float] = time.monotonic,
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Callable[[float], None] = time.sleep,
+                 autoscaler=None, share_identical: bool = False,
+                 pace_s: float = 0.0):
         self.registry = registry
         self.mesh = mesh
         self.clock = clock
         self._sleep = sleep
         self.stats_every_s = float(stats_every_s)
+        # dispatch pacing (ISSUE 19): yield the CPU for pace_s after
+        # every served dispatch.  A placement-aware knob for THROUGHPUT
+        # roles sharing a substrate with a latency role — a paced
+        # prefill host hands the core to a co-resident decode host at
+        # every op boundary instead of once per scheduler quantum, for
+        # a TTFT cost of pace_s per chunk (~1% of a long prefill).
+        # Meaningless co-located: there the prefill chunk and the
+        # decode step share ONE dispatch loop, so a pause here delays
+        # the victim it would protect.  Zero = off (default).
+        self.pace_s = float(pace_s)
+        # per-tenant autoscaling policy (fleet/autoscale.py): consulted
+        # by the dispatcher at its boundary with each tenant's queue
+        # depth; a returned weight is applied under the lock and
+        # announced as a fleet_autoscale event
+        self.autoscaler = autoscaler
+        # cross-tenant dispatch sharing (ISSUE 19 satellite): tenants
+        # whose models share exec_digest() (two checkpoints of one
+        # graph — same compiled programs, different params) are served
+        # back-to-back in ONE dispatcher turn, so the second rides the
+        # warm executables the first just ran.  Bit-parity vs separate
+        # turns is pinned in tests (the digest guarantees the same
+        # programs; only the params differ).
+        self.share_identical = bool(share_identical)
         self._lock = lockwatch.lock("FleetEngine._lock")
         self._tenants: Dict[str, _Tenant] = {}  # guarded_by: self._lock
         # swapped-out GENERATION tenants still holding active decode
@@ -235,6 +260,12 @@ class FleetEngine:
             "ff_fleet_dispatches_total",
             "Fleet dispatcher packed dispatches across all tenants",
             ("eng",)).labels(eng=self._fleet_eng)
+        self._c_shared = reg.counter(
+            "ff_fleet_shared_dispatches_total",
+            "Extra same-turn dispatches riding a digest-matched "
+            "tenant's warm programs (share_identical)",
+            ("eng",)).labels(eng=self._fleet_eng)
+        self._last_autoscale_t = 0.0  # dispatcher-thread-only
         # per-tenant vtime gauge children, resolved once per tenant —
         # the dispatch loop must not re-run label validation + the
         # family lock per packed dispatch
@@ -543,6 +574,8 @@ class FleetEngine:
         # re-create — and permanently resurrect — the stale series
         with self._lock:
             self._vtime_reclaim.append(name)
+        if self.autoscaler is not None:
+            self.autoscaler.forget(name)
         self._wake.set()
         get_logger("serve").event("fleet_unload", model=name,
                                   pending_failed=int(t.has_pending()))
@@ -610,12 +643,16 @@ class FleetEngine:
             self._do_publishes()
             self._do_vtime_reclaims()
             self._finalize_retiring()
+            self._maybe_autoscale()
             with self._lock:
                 draining = self._draining
                 tenants = (list(self._tenants.values())
                            + list(self._retiring))
             served = None
-            for t in self._pick_order(tenants):
+            rows0 = 0
+            rest: List[_Tenant] = []
+            order = self._pick_order(tenants)
+            for i, t in enumerate(order):
                 rows0 = t.engine.metrics.total_rows
                 # a tenant may be backlogged but not DUE (its
                 # micro-batcher is inside its coalescing window):
@@ -629,6 +666,7 @@ class FleetEngine:
                 self._in_flight = None
                 if dt is not None:
                     served = t
+                    rest = order[i + 1:]
                     break
             if served is None:
                 if draining and not any(x.has_pending()
@@ -641,22 +679,90 @@ class FleetEngine:
                 self._wake.clear()
                 continue
             t = served
-            self._n_dispatch += 1
-            self._c_dispatch.inc()
-            with self._lock:
-                t.vtime += dt / t.weight
-                if t.qps_rows > 0:
-                    t.allowance -= (t.engine.metrics.total_rows - rows0)
-            self._vclock = t.vtime
-            # the registry's view of the fairness state fleet_stats
-            # reports — same number, two surfaces
-            child = self._vtime_children.get(t.name)
-            if child is None:
-                child = self._g_vtime.labels(model=t.name,
-                                             eng=self._fleet_eng)
-                self._vtime_children[t.name] = child
-            child.set(t.vtime)
+            self._account_dispatch(t, dt, rows0)
+            if self.share_identical and rest:
+                self._share_turn(t, rest)
             self._maybe_emit_stats()
+            if self.pace_s > 0:
+                self._sleep(self.pace_s)
+
+    def _account_dispatch(self, t: _Tenant, dt: float,
+                          rows0: int) -> None:
+        """Charge one completed dispatch to its tenant's fairness
+        state + the registry surfaces (dispatcher thread)."""
+        self._n_dispatch += 1
+        self._c_dispatch.inc()
+        with self._lock:
+            t.vtime += dt / t.weight
+            if t.qps_rows > 0:
+                t.allowance -= (t.engine.metrics.total_rows - rows0)
+        self._vclock = t.vtime
+        # the registry's view of the fairness state fleet_stats
+        # reports — same number, two surfaces
+        child = self._vtime_children.get(t.name)
+        if child is None:
+            child = self._g_vtime.labels(model=t.name,
+                                         eng=self._fleet_eng)
+            self._vtime_children[t.name] = child
+        child.set(t.vtime)
+
+    @staticmethod
+    def _digest_of(t: _Tenant) -> Optional[str]:
+        try:
+            return t.engine.model.exec_digest()
+        except Exception:  # noqa: BLE001 — an undigestable model just
+            # opts out of sharing; it must never poison the dispatcher
+            return None
+
+    def _share_turn(self, primary: _Tenant,
+                    rest: List[_Tenant]) -> None:
+        """Cross-tenant dispatch sharing: serve every OTHER due tenant
+        whose model's ``exec_digest()`` matches the primary's in the
+        SAME dispatcher turn — identical graphs share compiled
+        programs (two checkpoints of one model: same executables,
+        different params), so the matched tenants ride the warm
+        programs the primary just ran instead of waiting a full SFQ
+        rotation.  Each extra dispatch is accounted exactly like a
+        primary one (vtime, qps bucket, counters) — sharing a turn is
+        a latency optimization, never a fairness subsidy."""
+        digest = self._digest_of(primary)
+        if digest is None:
+            return
+        for u in rest:
+            if u.kind != primary.kind:
+                continue
+            if self._digest_of(u) != digest:
+                continue
+            rows0 = u.engine.metrics.total_rows
+            self._in_flight = u.name
+            du = u.engine.dispatch_pending()
+            self._in_flight = None
+            if du is None:
+                continue
+            self._account_dispatch(u, du, rows0)
+            self._c_shared.inc()
+
+    def _maybe_autoscale(self) -> None:
+        """Feed the autoscaling policy each tenant's queue depth and
+        apply any weight change it returns (dispatcher thread — the
+        policy itself is single-threaded by construction)."""
+        scaler = self.autoscaler
+        if scaler is None:
+            return
+        now = self.clock()
+        with self._lock:
+            live = list(self._tenants.values())
+        for t in live:
+            depth = t.engine._batcher.queue_depth
+            new = scaler.observe(t.name, depth, t.weight, now)
+            if new is None:
+                continue
+            with self._lock:
+                old, t.weight = t.weight, new
+            get_logger("serve").event(
+                "fleet_autoscale", model=t.name,
+                old_weight=round(old, 4), new_weight=round(new, 4),
+                depth=depth)
 
     def _pick_order(self, tenants: List[_Tenant]) -> List[_Tenant]:
         """Start-time fair queuing: backlogged, within-budget tenants

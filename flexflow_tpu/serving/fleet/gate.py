@@ -29,7 +29,8 @@ from typing import Dict, List, Optional, Tuple
 
 from ...analysis.diagnostics import DiagnosticReport, make
 from ...analysis.kv_memory import (DEFAULT_PAGE_SIZE, default_serve_seq,
-                                   dtype_bytes, kv_cache_bytes)
+                                   dtype_bytes, kv_cache_bytes,
+                                   kv_page_plan)
 from ...analysis.strategy_passes import infer_mesh_shape
 from ...parallel.mesh import AbstractMesh
 from .registry import ModelRegistry, TenantSpec
@@ -113,6 +114,7 @@ def model_residency(spec: TenantSpec, layers, input_tensors, strategies,
     kv = 0.0
     slots = seq = 0
     kv_pages = kv_page = 0
+    plan = None
     if model_config is not None:
         compute_dtype = getattr(model_config, "compute_dtype",
                                 compute_dtype)
@@ -129,10 +131,11 @@ def model_residency(spec: TenantSpec, layers, input_tensors, strategies,
         kv_pages = (int(spec.generation.get("num_pages", 0))
                     or int(getattr(model_config, "serve_kv_pages", 0)))
         if slots > 0 and seq > 0:
-            kv = kv_cache_bytes(layers, mesh_shape, slots, seq,
+            plan = kv_page_plan(layers, mesh_shape, slots, seq,
                                 kv_dtype_bytes=dtype_bytes(compute_dtype),
                                 page_size=kv_page or DEFAULT_PAGE_SIZE,
                                 num_pages=kv_pages)
+            kv = plan["total_bytes"]
     sim = Simulator(spec=device_spec,
                     num_devices=max(1, mesh.mesh_product),
                     use_native=False, opt_slot_bytes=0)
@@ -163,9 +166,20 @@ def model_residency(spec: TenantSpec, layers, input_tensors, strategies,
                 kv_dtype_bytes=dtype_bytes(compute_dtype),
                 page_size=kv_page or DEFAULT_PAGE_SIZE,
                 num_pages=kv_pages)
+    role = getattr(spec, "role", "mixed")
+    staging = 0.0
+    if plan is not None and role == "prefill":
+        # disaggregated prefill engines (ISSUE 19): at migration one
+        # stream's covering page chain is materialized as a contiguous
+        # staging copy (export_pages' gather feeding the device_get).
+        # Transient, but the FF132 topology contract charges one
+        # chain's worth as prefill headroom so the gate and the router
+        # cannot diverge on whether a migrating fleet fits.
+        staging = plan["pages_per_slot"] * plan["page_bytes"]
     return {
         "name": spec.name,
         "engine": spec.engine,
+        "role": role,
         "mesh": {a: s for a, s in mesh_shape.items() if s > 1} or {"n": 1},
         "params_bytes": params,
         "quantize": getattr(spec, "quantize", ""),
@@ -173,17 +187,26 @@ def model_residency(spec: TenantSpec, layers, input_tensors, strategies,
         "kv_bytes": kv,
         "kv_slots": slots,
         "kv_seq": seq,
+        # resolved page geometry (0 = not a sized generation tenant):
+        # the FF132 disagg checks compare these across roles
+        "kv_page_size": plan["page_size"] if plan else 0,
+        "kv_num_pages": plan["num_pages"] if plan else 0,
+        "kv_pages_per_slot": plan["pages_per_slot"] if plan else 0,
+        "staging_bytes": staging,
         "draft": draft_name,
         "draft_bytes": draft_bytes,
-        # the byte-for-byte pin vs the engine's real allocation
+        # the byte-for-byte pin vs the engine's real allocation (the
+        # staging copy is a migration-time transient, NOT part of the
+        # always-resident pin)
         "resident_bytes": params + kv + draft_bytes,
         # the gate quantity: FF108 accounting + the unscaled KV scalar
         # (a preallocated buffer has no XLA temps — same rule as the
         # single-model lint --serve-slots path).  The quantization
         # delta rides UNSCALED too, like the KV cache: an int8 buffer
         # swap has no XLA-temp component.  The draft's params + pool
-        # are preallocated residency of the SAME kind.
-        "ff108_bytes": peak + kv + quant_delta + draft_bytes,
+        # are preallocated residency of the SAME kind.  Prefill-role
+        # tenants additionally carry the migration staging chain.
+        "ff108_bytes": peak + kv + quant_delta + draft_bytes + staging,
     }
 
 
@@ -245,6 +268,48 @@ def fleet_gate_report(registry: ModelRegistry,
             f"{row['ff108_bytes'] / 1e9:.2f} GB peak "
             f"({row['params_bytes'] / 1e9:.2f} GB params{kv_note}"
             f"{draft_note})"))
+    # ---- FF132: disaggregated-topology checks (ISSUE 19) ------------
+    # A role-tagged fleet is a migration contract: the router ships KV
+    # page chains from prefill-role tenants into decode-role pools, so
+    # the gate must refuse topologies the migration protocol cannot
+    # serve — BEFORE the first stream fails at import time.
+    gen_rows = [r for r in rows if r["engine"] == "generation"]
+    prefill_rows = [r for r in gen_rows if r["role"] == "prefill"]
+    decode_rows = [r for r in gen_rows if r["role"] == "decode"]
+    if prefill_rows and not any(r["role"] in ("decode", "mixed")
+                                for r in gen_rows):
+        report.add(make(
+            "FF132", "",
+            f"prefill-role tenant(s) "
+            f"{[r['name'] for r in prefill_rows]} have no decode/mixed "
+            f"migration target in this fleet",
+            hint="tag a generation tenant role='decode' (or 'mixed') "
+                 "or drop the prefill tag — a prefill engine with "
+                 "nowhere to migrate decodes co-located forever"))
+    for r in decode_rows:
+        need = r["kv_slots"] * r["kv_pages_per_slot"]
+        if need and r["kv_num_pages"] < need:
+            report.add(make(
+                "FF132", r["name"],
+                f"decode pool has {r['kv_num_pages']} pages but "
+                f"adopting {r['kv_slots']} migrated full-length "
+                f"streams needs {need} "
+                f"({r['kv_pages_per_slot']} pages x {r['kv_slots']} "
+                f"slots)",
+                hint="migrated chains arrive at full prompt length "
+                     "with no shared-prefix guarantee — size "
+                     "num_pages to slots x ceil(max_seq/page_size) "
+                     "or lower slots"))
+    role_sizes = {r["kv_page_size"] for r in gen_rows
+                  if r["role"] != "mixed" and r["kv_page_size"]}
+    if len(role_sizes) > 1:
+        report.add(make(
+            "FF132", "",
+            f"prefill/decode tenants disagree on page_size "
+            f"{sorted(role_sizes)} — import_pages requires identical "
+            f"page geometry on both ends",
+            hint="set one generation.page_size across every "
+                 "role-tagged tenant"))
     if total > hbm:
         worst = max(rows, key=lambda r: r["ff108_bytes"])
         report.add(make(

@@ -40,6 +40,13 @@ import dataclasses
 import json
 from typing import Callable, Dict, List, Optional
 
+# roles a host/tenant may take in a disaggregated cluster (ISSUE 19,
+# docs/serving.md "Disaggregated prefill/decode"): "prefill" engines
+# serve prefill chunks then migrate the KV page chain out, "decode"
+# engines adopt migrated streams and dispatch nothing but decode
+# steps, "mixed" (the default) does both co-located
+TENANT_ROLES = ("prefill", "decode", "mixed")
+
 # "draft" tenants are graphs co-hosted ONLY as a generation tenant's
 # speculative-decoding draft (referenced via generation.draft): the
 # fleet builds their params but never starts an engine for them — the
@@ -84,6 +91,11 @@ class TenantSpec:
     # (dense tenants only — FFModel.quantize_weights at engine warmup;
     # the co-residency gate accounts the int8 footprint byte-for-byte)
     quantize: str = ""
+    # disaggregated-cluster role (TENANT_ROLES); only meaningful for
+    # generation tenants — the router routes prompts to "prefill"/
+    # "mixed" and migrates KV pages to "decode", and the FF132 gate
+    # sizes decode pools / charges prefill staging bytes off this tag
+    role: str = "mixed"
     serve: Dict = dataclasses.field(default_factory=dict)
     generation: Dict = dataclasses.field(default_factory=dict)
 
@@ -118,6 +130,15 @@ class TenantSpec:
             raise ValueError(
                 f"tenant {self.name!r}: qps_rows must be >= 0 "
                 f"(0 = unlimited), got {self.qps_rows}")
+        if self.role not in TENANT_ROLES:
+            raise ValueError(
+                f"tenant {self.name!r}: role must be one of "
+                f"{TENANT_ROLES}, got {self.role!r}")
+        if self.role != "mixed" and self.engine != "generation":
+            raise ValueError(
+                f"tenant {self.name!r}: role {self.role!r} applies to "
+                f"generation tenants only (dense/draft tenants have no "
+                f"prefill/decode split to disaggregate)")
         if self.engine == "draft" and (self.serve or self.generation):
             raise ValueError(
                 f"tenant {self.name!r}: draft entries serve no traffic "
@@ -175,6 +196,13 @@ def validate_fleet_json(obj) -> List[str]:
         if kind not in ENGINE_KINDS:
             probs.append(f"{where}: engine must be one of "
                          f"{', '.join(ENGINE_KINDS)}, got {kind!r}")
+        role = e.get("role", "mixed")
+        if role not in TENANT_ROLES:
+            probs.append(f"{where}: role must be one of "
+                         f"{', '.join(TENANT_ROLES)}, got {role!r}")
+        elif role != "mixed" and kind != "generation":
+            probs.append(f"{where}: role {role!r} applies to generation "
+                         f"tenants only")
         for key, want in (("checkpoint", str), ("strategy", str)):
             if key in e and not isinstance(e[key], want):
                 probs.append(f"{where}: {key} must be a string")
@@ -295,6 +323,7 @@ class ModelRegistry:
                 qps_rows=float(e.get("qps_rows", 0.0)),
                 batch_size=int(e.get("batch_size", 0)),
                 quantize=str(e.get("quantize", "")),
+                role=str(e.get("role", "mixed")),
                 serve=dict(e.get("serve", {})),
                 generation=dict(e.get("generation", {})))
         return reg
@@ -383,4 +412,5 @@ def build_model(spec: TenantSpec, mesh=None):
 
 
 __all__ = ["ModelRegistry", "TenantSpec", "validate_fleet_json",
-           "builtin_builders", "build_model", "ENGINE_KINDS"]
+           "builtin_builders", "build_model", "ENGINE_KINDS",
+           "TENANT_ROLES"]

@@ -71,7 +71,7 @@ from ..errors import (GenerationCancelled, KVCacheExhausted,
                       OverloadError, SheddedError)
 from ..metrics import ServingMetrics
 from .decoder import GraphDecoder
-from .pages import KVPagePool, PrefixCache
+from .pages import KVPagePool, PrefixCache, export_pages, import_pages
 from .sampling import SamplingParams
 
 _END = object()  # token-stream sentinel
@@ -118,8 +118,16 @@ class GenerationStream:
 
     def __init__(self, prompt_len: int, max_new: int, t_submit: float,
                  deadlined: bool = False, trace: Optional[str] = None,
-                 sampling: Optional[SamplingParams] = None):
+                 sampling: Optional[SamplingParams] = None,
+                 handoff=None):
         self.future: Future = Future()
+        # disaggregated prefill/decode (docs/serving.md): when set, the
+        # engine offers this stream's KV page chain to the callable at
+        # prefill completion (``handoff(payload) -> bool``); True means
+        # a DECODE engine adopted the stream and the source frees its
+        # slot, False/raise falls back to co-located decode failing
+        # nothing.  Set at submit() — the router's migration hook.
+        self.handoff = handoff
         self.prompt_len = int(prompt_len)
         self.max_new = int(max_new)
         self.t_submit = t_submit
@@ -394,8 +402,10 @@ class GenerationMetrics(ServingMetrics):
         return snap
 
     def emit(self, extra: Dict | None = None) -> None:
-        get_logger("serve").event("gen_stats", **self.snapshot(),
-                                  **(extra or {}))
+        # eng rides as an event field for the same reason as
+        # serve_stats': the cluster router's scrape keys on it
+        get_logger("serve").event("gen_stats", eng=self.eng_id,
+                                  **self.snapshot(), **(extra or {}))
 
 
 class GenerationEngine:
@@ -446,7 +456,7 @@ class GenerationEngine:
                  spec_policy: Optional[str] = None,
                  stats_every: int = 32, metrics_window_s: float = 30.0,
                  clock=time.monotonic, sleep=time.sleep,
-                 name: str = ""):
+                 name: str = "", device=None):
         assert model._compiled, "compile() + init_layers() the model first"
         _enable_compile_cache()
         cfg = model.config
@@ -462,6 +472,19 @@ class GenerationEngine:
                 "engine (weight quantization covers dense serving "
                 "only); unset FFConfig.serve_quantize for this model")
         self.model = model
+        # ``device`` pins THIS engine's dispatches to one jax device:
+        # its params copy is committed there, and every program
+        # (prefill/decode/verify) follows the committed operand, so
+        # N co-resident engines drive N accelerators independently —
+        # the disaggregated cluster's placement primitive (a second
+        # host-platform CPU device stands in for the second chip in
+        # single-host runs).  None = the model's own placement.
+        self.device = device
+        if device is None:
+            self._params = model._params
+        else:
+            import jax
+            self._params = jax.device_put(model._params, device)
         self.slots = int(slots or cfg.serve_gen_slots)
         seq_len = (model.input_tensors[0].shape[1]
                    if model.input_tensors else 0)
@@ -540,6 +563,17 @@ class GenerationEngine:
         self._table = np.full((self.slots, self._decoder.pages_per_slot),
                               self._pool.no_page, np.int32)
         self._prefill_q: deque = deque()  # (slot, _Slot) FIFO
+        # migrated-stream inbox (disaggregated serving): the ROUTER's
+        # handoff appends host-only payloads from the SOURCE engine's
+        # dispatcher thread; this thread drains it at step boundaries
+        # (CPython deque append/popleft are atomic — no lock, no
+        # cross-engine lock-order edge for the fflock gate to flag)
+        self._adopt_q: deque = deque()
+        # per-migration wall costs (ms), export side and import side —
+        # the calibrated-replay bench reads these as the REAL price of
+        # a migration on this substrate
+        self.migrate_export_ms: List[float] = []
+        self.migrate_import_ms: List[float] = []
         self._caches = None
         self._n_steps = 0
         self._chunks_total = 0
@@ -558,6 +592,7 @@ class GenerationEngine:
         # geometry (its rows mirror the target's positions 1:1), and
         # the fleet gate charges them byte-for-byte.
         self.draft_model = draft_model
+        self._draft_params = None
         self._draft_decoder = None
         self._draft_pool: Optional[KVPagePool] = None
         self._draft_table = None
@@ -615,6 +650,12 @@ class GenerationEngine:
                 kv_dtype_bytes=dtype_bytes(cfg.compute_dtype),
                 page_size=self.page_size, num_pages=self.num_pages)
             self.draft_kv_cache_bytes = self.draft_kv_plan["total_bytes"]
+            if device is None:
+                self._draft_params = draft_model._params
+            else:
+                import jax
+                self._draft_params = jax.device_put(
+                    draft_model._params, device)
             self._draft_pool = KVPagePool(self.num_pages, self.page_size)
             self._draft_table = np.full(
                 (self.slots, self._draft_decoder.pages_per_slot),
@@ -661,7 +702,7 @@ class GenerationEngine:
         it at start() keeps steady-state latency flat.  The dummy
         dispatches ride an all-sentinel page table, so every pool
         write DROPS — warmup leaves the pool bit-clean."""
-        params = self.model._params
+        params = self._params
         no_table = np.full((self._decoder.pages_per_slot,),
                            self._pool.no_page, np.int32)
         for b in self._decoder.buckets:
@@ -687,7 +728,7 @@ class GenerationEngine:
         one dummy round per γ — the calibrated per-dispatch cost the
         adaptive controller prices against the live accept rate.
         Sentinel tables again: warmup writes all drop."""
-        dparams = self.draft_model._params
+        dparams = self._draft_params
         ddec = self._draft_decoder
         no_row = np.full((ddec.pages_per_slot,),
                          self._draft_pool.no_page, np.int32)
@@ -717,7 +758,7 @@ class GenerationEngine:
                     dparams, self._draft_caches, tokens, pos, dtable,
                     dwp, dwr)
                 (n_acc, out), self._caches = vfn(
-                    self.model._params, self._caches, tokens, d, pos,
+                    self._params, self._caches, tokens, d, pos,
                     vtable, vwp, vwr)
                 jax.device_get((n_acc, out))
                 if probe:
@@ -895,8 +936,9 @@ class GenerationEngine:
         keeps serving)."""
         t0 = self.clock()
         self._batcher.reap_expired()
+        adopted = self._join_adopted()
         self._admit()
-        progressed = self._prefill_step()
+        progressed = self._prefill_step() or adopted
         self._grow_active_pages()
         if not any(s is not None and not s.prefilling
                    for s in self._slots_state):
@@ -913,17 +955,18 @@ class GenerationEngine:
     @property
     def has_pending(self) -> bool:
         """Whether the engine has work an external dispatcher should
-        schedule: occupied decode slots (active or prefilling) or
-        queued prompts."""
+        schedule: occupied decode slots (active or prefilling), queued
+        prompts, or migrated streams awaiting adoption."""
         return (any(s is not None for s in self._slots_state)
-                or self._batcher.queue_depth > 0)
+                or self._batcher.queue_depth > 0
+                or len(self._adopt_q) > 0)
 
     # ---- producer side -------------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                deadline_ms: Optional[float] = None,
                priority: int = 0,
-               sampling: Optional[SamplingParams] = None
-               ) -> GenerationStream:
+               sampling: Optional[SamplingParams] = None,
+               handoff=None) -> GenerationStream:
         """Queue one prompt (1-D int token ids) and return its
         :class:`GenerationStream`.  Thread-safe.
 
@@ -938,7 +981,13 @@ class GenerationEngine:
         (temperature/top-k/top-p, seeded — see
         :class:`~.sampling.SamplingParams`); None or temperature 0 is
         greedy argmax, and a batch with no sampled request dispatches
-        the UNSAMPLED programs so the bit-parity pins hold exactly."""
+        the UNSAMPLED programs so the bit-parity pins hold exactly.
+
+        ``handoff`` (disaggregated serving) is an optional
+        ``callable(payload) -> bool`` the engine offers the stream's
+        exported KV pages to at prefill completion — True migrates the
+        stream to a decode engine, False/raise keeps decoding here
+        (see :meth:`adopt_migrated`)."""
         arr = np.asarray(prompt, dtype=np.int32).reshape(-1)
         if arr.size < 1:
             raise ValueError("empty prompt")
@@ -962,7 +1011,8 @@ class GenerationEngine:
         trace = tr.new_trace() if tr.active else None
         stream = GenerationStream(arr.size, max_new, t0,
                                   deadlined=deadline_ms is not None,
-                                  trace=trace, sampling=sampling)
+                                  trace=trace, sampling=sampling,
+                                  handoff=handoff)
         deadline = None if deadline_ms is None else t0 + deadline_ms / 1e3
         metrics = self.metrics
         trace_term = self._trace_terminal
@@ -1072,6 +1122,7 @@ class GenerationEngine:
             # fail AT the deadline (PR 8's contract), not when a slot
             # happens to free
             self._batcher.reap_expired()
+            self._join_adopted()
             self._admit()
             progressed = self._prefill_step()
             self._grow_active_pages()
@@ -1196,7 +1247,7 @@ class GenerationEngine:
             with jax.profiler.StepTraceAnnotation(
                     "gen-prefill", step_num=self._n_steps):
                 first, self._caches = fn(
-                    self.model._params, self._caches, tokens,
+                    self._params, self._caches, tokens,
                     self._table[slot].copy(), np.int32(slot),
                     np.int32(start), np.int32(chunk))
                 if final:
@@ -1243,10 +1294,210 @@ class GenerationEngine:
                               prompt_len=int(prompt.size),
                               prefix_hit_tokens=st.hit_tokens,
                               prefill_chunks=st.chunks)
+        if stream.handoff is not None and not (
+                st.generated >= stream.max_new
+                or (self.eos_id is not None and tok == self.eos_id)):
+            # disaggregated serving: offer the freshly-prefilled KV
+            # page chain to the router's handoff.  Streams retiring at
+            # this very boundary (max_new=1, first token is EOS) stay
+            # local — migrating them would ship pages nothing decodes.
+            if self._migrate_out(slot, st, now):
+                return True
         if self._spec_active():
             self._draft_prefill(slot, st)
         self._retire(slot, st, now)
         return True
+
+    # ---- disaggregated prefill/decode migration ------------------------
+    def _migrate_out(self, slot: int, st: _Slot, now: float) -> bool:
+        """Export the slot's KV pages + stream state and offer them to
+        ``stream.handoff``.  True = a decode engine adopted the stream:
+        the source frees the slot (shared prefix pages stay cached —
+        the trie holds its own references).  False = fallback to
+        co-located decode with ONE ``serve_health`` event and NO stream
+        failed — the slot is untouched either way until adoption is
+        confirmed."""
+        stream = st.stream
+        t0 = self.clock()
+        try:
+            if not (self._decoder.has_attention
+                    and self._decoder.supports_chunking):
+                raise RuntimeError(
+                    "graph state is not pageable (no paged attention): "
+                    "KV migration needs a chunkable attention graph")
+            e0 = time.perf_counter()
+            host = export_pages(self._caches, st.pages, self.num_pages,
+                                pad_to=self._decoder.pages_per_slot)
+            self.migrate_export_ms.append(
+                (time.perf_counter() - e0) * 1e3)
+            # charge only the REAL chain (the pad rows are a fixed-
+            # shape compile-cache artifact, not shipped state)
+            nbytes = sum(int(a.nbytes) // int(a.shape[0])
+                         for sub in host.values()
+                         for a in sub.values()) * len(st.pages)
+            payload = {
+                "stream": stream,
+                "prompt": st.prompt,
+                "pages": host,
+                "pages_used": len(st.pages),
+                "nbytes": nbytes,
+                "page_size": self.page_size,
+                "last_token": int(st.last_token),
+                "length": int(st.length),
+                "generated": int(st.generated),
+                "source": self.name,
+            }
+            adopted = bool(stream.handoff(payload))
+        except BaseException as e:  # noqa: BLE001 — a failed export or
+            # handoff must cost this stream NOTHING but staying local
+            self._migrate_fallback(slot, e)
+            return False
+        if not adopted:
+            self._migrate_fallback(slot, None)
+            return False
+        if self._tracer.active and stream.trace is not None:
+            self._tracer.span("migrate", stream.trace, t0, self.clock(),
+                              tid=self.name or "generate", slot=slot,
+                              phase="export", pages=len(st.pages),
+                              bytes=payload["nbytes"])
+        # the destination owns the stream now: free the slot WITHOUT
+        # finishing it.  release() drops the slot's references only —
+        # prefix pages the trie promoted stay resident here, so a
+        # same-prefix prompt still hits.
+        self._release_slot(slot, st)
+        return True
+
+    def _migrate_fallback(self, slot: int, exc) -> None:
+        """Migration declined/failed: one health event (mirror of
+        ``_spec_demote`` — NO stream fails, decode continues
+        co-located on this engine) plus a flight dump when it was an
+        error rather than a routing decision."""
+        err = ("" if exc is None
+               else f"{type(exc).__name__}: {exc}"[:300])
+        get_logger("serve").event(
+            "serve_health", model=self.name, component="migration",
+            status="fallback", slot=slot,
+            reason=("handoff_declined" if exc is None
+                    else "handoff_error"),
+            error=err, step=self._n_steps)
+        if exc is not None:
+            flight_dump("gen_migrate_error",
+                        extra={"model": self.name, "slot": slot,
+                               "error": err, "step": self._n_steps})
+
+    def adopt_migrated(self, payload: Dict) -> bool:
+        """Decode-engine side of migration: enqueue an
+        :func:`~.pages.export_pages` payload (plus stream state) for
+        adoption at this engine's next dispatch boundary.  Thread-safe
+        (the source engine's dispatcher calls this through the router's
+        handoff): the payload is host-only data and the deque append is
+        atomic — the import itself runs on THIS engine's dispatch
+        thread, which owns the pool/caches (single-writer
+        discipline)."""
+        with self._lifecycle:
+            if self._stopped or self._closing.is_set():
+                return False
+        self._adopt_q.append(payload)
+        return True
+
+    def _join_adopted(self) -> bool:
+        """Import ONE queued migrated stream into a free slot
+        (dispatcher thread).  A payload with no free slot waits at the
+        queue head — slots free as streams retire.  One adoption per
+        dispatch boundary bounds the decode-step gap co-hosted streams
+        pay for an arriving migration burst by a single import; the
+        queue drains across consecutive turns (``has_pending`` keeps
+        the dispatcher coming back).  Returns True when a stream
+        joined."""
+        if not self._adopt_q:
+            return False
+        if not any(s is None for s in self._slots_state):
+            return False
+        try:
+            payload = self._adopt_q.popleft()
+        except IndexError:
+            return False
+        self._import_migrated(payload)
+        return True
+
+    def _import_migrated(self, payload: Dict) -> None:
+        """Allocate destination pages, scatter the payload in with one
+        ``device_put`` (:func:`~.pages.import_pages`), and seat the
+        stream in a free slot mid-generation — decode continues here
+        bit-for-bit where the source's prefill left off.  The prompt's
+        full pages are promoted into THIS engine's prefix trie (the
+        accounting parity with a co-located join); pool exhaustion is
+        the same legitimate shed as a co-located allocation failure."""
+        stream: GenerationStream = payload["stream"]
+        prompt = np.asarray(payload["prompt"], np.int32).reshape(-1)
+        now = self.clock()
+        slot = next((i for i, s in enumerate(self._slots_state)
+                     if s is None), None)
+        if slot is None:  # _join_adopted guards this; never strand
+            self._adopt_q.appendleft(payload)
+            return
+        first = next(iter(next(iter(payload["pages"].values())).values()))
+        need = int(payload.get("pages_used") or first.shape[0])
+        pages: List[int] = []
+        while len(pages) < need:
+            pg = self._alloc_page()
+            if pg is None:
+                break
+            pages.append(pg)
+        if len(pages) < need or int(payload["page_size"]) != \
+                self.page_size:
+            for pg in pages:
+                self._pool.release(pg)
+            exc = KVCacheExhausted(
+                f"cannot adopt migrated stream: need {need} page(s) "
+                f"of size {payload['page_size']} (pool {self.num_pages} "
+                f"pages of {self.page_size}, {self._pool.pages_in_use} "
+                f"in use)")
+            if stream._fail(exc):
+                self.metrics.record_failure(exc)
+                self._trace_terminal(stream, "shed", now)
+            return
+        try:
+            i0 = time.perf_counter()
+            self._caches = import_pages(self._caches, payload["pages"],
+                                        pages)
+            self.migrate_import_ms.append(
+                (time.perf_counter() - i0) * 1e3)
+        except BaseException as e:  # noqa: BLE001 — a poisoned import
+            # fails only the migrating stream (import_pages validates
+            # every leaf BEFORE its first donating scatter, so a graph
+            # or geometry mismatch leaves the resident pool untouched)
+            for pg in pages:
+                self._pool.release(pg)
+            if stream._fail(e):
+                self.metrics.record_failure(e)
+                self._trace_terminal(stream, "error", now)
+            return
+        st = _Slot(stream, prompt, [], self.page_size, now)
+        st.hit_tokens = 0
+        st.pages = pages
+        st.prefilling = False
+        st.length = int(payload["length"])
+        st.next_pos = st.length
+        st.last_token = int(payload["last_token"])
+        st.generated = int(payload["generated"])
+        for i, pg in enumerate(pages):
+            self._table[slot, i] = pg
+        self._slots_state[slot] = st
+        if self._tracer.active and stream.trace is not None:
+            self._tracer.span("migrate", stream.trace, now, self.clock(),
+                              tid=self.name or "generate", slot=slot,
+                              phase="import", pages=len(pages),
+                              bytes=int(payload.get("nbytes", 0)),
+                              source=str(payload.get("source", "")))
+        if self._prefix is not None:
+            full = max(0, (int(prompt.size) - 1)) // self.page_size
+            self._prefix.insert(prompt, st.pages[:full])
+        if self._spec_active():
+            # speculative decoding composes with disaggregation by
+            # co-hosting the draft with the DECODE engine: mirror the
+            # prompt into the draft cache exactly like a local join
+            self._draft_prefill(slot, st)
 
     # ---- page bookkeeping ----------------------------------------------
     def _alloc_page(self) -> Optional[int]:
@@ -1387,12 +1638,12 @@ class GenerationEngine:
                 temp, top_k, top_p, seeds = self._sampling_arrays()
                 fn = self._decoder.decode_sampled_fn()
                 nxt, self._caches = fn(
-                    self.model._params, self._caches, tokens, pos,
+                    self._params, self._caches, tokens, pos,
                     self._table.copy(), wp, wr, temp, top_k, top_p,
                     seeds)
             else:
                 fn = self._decoder.decode_fn()
-                nxt, self._caches = fn(self.model._params, self._caches,
+                nxt, self._caches = fn(self._params, self._caches,
                                        tokens, pos, self._table.copy(),
                                        wp, wr)
             # THE one host sync per decode step for the whole batch —
@@ -1493,13 +1744,13 @@ class GenerationEngine:
                 if sampled:
                     dfn = self._draft_decoder.draft_fn(g, sampled=True)
                     (d, q), self._draft_caches = dfn(
-                        self.draft_model._params, self._draft_caches,
+                        self._draft_params, self._draft_caches,
                         tokens, pos, self._draft_table.copy(), dwp,
                         dwr, temp, top_k, top_p, seeds)
                 else:
                     dfn = self._draft_decoder.draft_fn(g)
                     d, self._draft_caches = dfn(
-                        self.draft_model._params, self._draft_caches,
+                        self._draft_params, self._draft_caches,
                         tokens, pos, self._draft_table.copy(), dwp,
                         dwr)
         except BaseException as e:  # noqa: BLE001 — draft-side only:
@@ -1522,12 +1773,12 @@ class GenerationEngine:
                 "generate", step_num=self._n_steps):
             if sampled:
                 (n_acc, out), self._caches = vfn(
-                    self.model._params, self._caches, tokens, d, q,
+                    self._params, self._caches, tokens, d, q,
                     pos, self._table.copy(), vwp, vwr, temp, top_k,
                     top_p, seeds)
             else:
                 (n_acc, out), self._caches = vfn(
-                    self.model._params, self._caches, tokens, d, pos,
+                    self._params, self._caches, tokens, d, pos,
                     self._table.copy(), vwp, vwr)
             # THE one host sync per round for the whole batch (RL010):
             # accept counts + the emit-ready token rows together
@@ -1636,7 +1887,7 @@ class GenerationEngine:
             with jax.profiler.StepTraceAnnotation(
                     "gen-draft-prefill", step_num=self._n_steps):
                 _, self._draft_caches = fn(
-                    self.draft_model._params, self._draft_caches,
+                    self._draft_params, self._draft_caches,
                     tokens, self._draft_table[slot].copy(),
                     np.int32(slot), np.int32(0), np.int32(size))
             if self._tracer.active and st.stream.trace is not None:
@@ -1827,6 +2078,16 @@ class GenerationEngine:
                 self._trace_terminal(s.stream, "shed", now)
             self._release_slot(i, s)
         self._prefill_q.clear()
+        while self._adopt_q:
+            try:
+                payload = self._adopt_q.popleft()
+            except IndexError:
+                break
+            exc = SheddedError(
+                "engine drained before adopting a migrated stream")
+            if payload["stream"]._fail(exc):
+                self.metrics.record_failure(exc)
+                self._trace_terminal(payload["stream"], "shed", now)
 
     # ---- fault injection (FF_FAULT generation kinds) -------------------
     def _fire_slow_decode(self) -> None:

@@ -278,4 +278,118 @@ def alloc_pool_arrays(layout: Dict[str, Dict], mesh, compute_dtype):
     return caches
 
 
-__all__ = ["KVPagePool", "PrefixCache", "alloc_pool_arrays"]
+def export_pages(caches, pages: List[int], num_pages: int,
+                 pad_to: int = 0):
+    """Gather a slot's page rows out of every pool leaf and bring them
+    to host in ONE ``device_get`` — the export half of disaggregated
+    prefill/decode migration (docs/serving.md "Disaggregated
+    prefill/decode").  ``pages`` is the slot's page-id chain IN ORDER;
+    every leaf must be page-major (``shape[0] == num_pages``), which is
+    true exactly for the attention K/V pools — LSTM ``state`` leaves
+    are slot-major and cannot migrate (the engine gates migration on
+    chunkable attention graphs for the same reason).  Returns a host
+    pytree ``{op: {leaf: np.ndarray[rows, ...]}}``.
+
+    ``pad_to`` pads the gather index to a FIXED row count by repeating
+    the last page id (the caller passes its pages-per-slot maximum):
+    the gather then traces one XLA program per pool geometry instead
+    of one per chain length, so a migration never pays a fresh compile
+    mid-serve.  :func:`import_pages` mirrors the padding; the real
+    chain length travels beside the payload."""
+    import jax
+    import numpy as np
+
+    idx = np.asarray(list(pages), np.int32)
+    if pad_to > idx.size:
+        idx = np.concatenate(
+            [idx, np.full(pad_to - idx.size, idx[-1], np.int32)])
+    gathered: Dict[str, Dict] = {}
+    for name, sub in caches.items():
+        rows = {}
+        for leaf, arr in sub.items():
+            if arr.shape[0] != num_pages:
+                raise ValueError(
+                    f"cache leaf {name}.{leaf} is not page-major "
+                    f"(shape {tuple(arr.shape)}, pool has {num_pages} "
+                    f"pages): this graph's state cannot migrate")
+            rows[leaf] = arr[idx]
+        gathered[name] = rows
+    # one transfer for the whole pytree (RL010-class budget: migration
+    # costs one sync on the source, one put on the destination)
+    return jax.device_get(gathered)
+
+
+def import_pages(caches, payload, pages: List[int]):
+    """Scatter an :func:`export_pages` payload into ``pages`` of the
+    DESTINATION pool with ONE ``device_put`` of the payload pytree —
+    the import half of KV page migration.  ``pages`` are freshly
+    allocated destination page ids (one per exported page, same order).
+    Returns the updated caches pytree (functional ``.at[].set`` — the
+    caller reassigns its ``_caches``).
+
+    A payload with MORE rows than ``pages`` was export-padded: the
+    destination index is padded the same way (repeat the last real
+    page id), so the duplicate scatter positions rewrite the last real
+    page with its own row — idempotent — and the scatter keeps one
+    fixed shape per pool geometry.
+
+    The pool leaf is DONATED into the scatter: the caller must treat
+    the input caches as consumed (the engine reassigns ``_caches`` to
+    the return value, and nothing else aliases the pool arrays), so
+    the update is in-place where the backend allows instead of a
+    full-pool copy per migration."""
+    import jax
+    import numpy as np
+
+    idx = np.asarray(list(pages), np.int32)
+    dev = jax.device_put(payload)
+    rows0 = next(iter(next(iter(dev.values())).values())).shape[0] \
+        if isinstance(dev, dict) and dev else idx.size
+    if rows0 > idx.size:
+        idx = np.concatenate(
+            [idx, np.full(rows0 - idx.size, idx[-1], np.int32)])
+    # validate EVERYTHING before the first donating scatter: a graph/
+    # geometry mismatch must leave the resident pool untouched (the
+    # engine's per-stream containment); once validation passed, the
+    # only scatter failures left are catastrophic backend errors
+    for name, sub in caches.items():
+        rows = dev.get(name) if isinstance(dev, dict) else None
+        if rows is None or set(rows) != set(sub):
+            raise ValueError(
+                f"migration payload does not cover cache op {name!r}: "
+                f"source and destination graphs differ")
+        for leaf, arr in sub.items():
+            val = rows[leaf]
+            if tuple(val.shape[1:]) != tuple(arr.shape[1:]) \
+                    or val.shape[0] != idx.size:
+                raise ValueError(
+                    f"migration payload {name}.{leaf} shape "
+                    f"{tuple(val.shape)} does not fit destination pool "
+                    f"leaf {tuple(arr.shape)} over {idx.size} page(s): "
+                    f"page geometry must match across engines")
+    out: Dict[str, Dict] = {}
+    for name, sub in caches.items():
+        rows = dev[name]
+        out[name] = {
+            leaf: _scatter_rows(arr, idx, rows[leaf].astype(arr.dtype))
+            for leaf, arr in sub.items()}
+    return out
+
+
+_SCATTER_ROWS = None
+
+
+def _scatter_rows(arr, idx, val):
+    """One jitted, BUFFER-DONATING row scatter shared by every import
+    (fixed shape per pool geometry — see the padding contract above):
+    in-place on backends that honor donation, one compile ever."""
+    global _SCATTER_ROWS
+    if _SCATTER_ROWS is None:
+        import jax
+        _SCATTER_ROWS = jax.jit(
+            lambda a, i, v: a.at[i].set(v), donate_argnums=(0,))
+    return _SCATTER_ROWS(arr, idx, val)
+
+
+__all__ = ["KVPagePool", "PrefixCache", "alloc_pool_arrays",
+           "export_pages", "import_pages"]

@@ -469,5 +469,9 @@ class ServingMetrics:
         """One ``serve_stats`` JSON event line on the ``serve`` logger
         (fflogger.Category.event) — the serving analogue of fit()'s
         per-epoch event."""
-        get_logger("serve").event("serve_stats", **self.snapshot(),
-                                  **(extra or {}))
+        # eng rides as an event field (not in snapshot(): stats() is a
+        # per-engine view already) so stream consumers — the cluster
+        # router's load scrape — can attribute same-named tenants on
+        # different hosts to the right engine generation
+        get_logger("serve").event("serve_stats", eng=self.eng_id,
+                                  **self.snapshot(), **(extra or {}))

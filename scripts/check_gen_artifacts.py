@@ -13,6 +13,14 @@
   PLUS the acceptance booleans (tokens/s win over the gamma=0 arm,
   greedy bit-parity, sampled reproducibility) must all be True, and
   the win boolean must agree with the recorded per-arm tokens_per_s.
+* ``artifacts/disagg_bench_r19.json`` — the ISSUE 19 disaggregated
+  prefill/decode evidence: colo chunked arms + the disagg arm's
+  structural schema PLUS the acceptance booleans (victim stall and
+  TPOT p95 strictly better than the goodput-qualified colo baseline,
+  goodput no worse, colo/disagg tokens bit-identical with the prefix
+  cache on AND off, cross-engine reconciliation, every stream
+  migrated) must all be True, and the stall/goodput booleans must
+  agree with the recorded per-arm rows.
 * ``artifacts/pallas_flags_*.json`` — the per-device-kind Pallas
   decision artifacts ``scripts/decide_pallas_flags.sh`` emits: each
   must carry the schema version, device kind, and an on/speedup/row
@@ -190,6 +198,88 @@ def check_spec_bench(path: str = SPEC_BENCH) -> int:
     return rc
 
 
+DISAGG_BENCH = os.path.join(REPO, "artifacts", "disagg_bench_r19.json")
+
+_DISAGG_ACCEPTANCE = ("tpot_p95_better", "victim_stall_better",
+                      "goodput_no_worse", "tokens_bit_identical",
+                      "reconciliation_ok", "all_migrated")
+
+
+def check_disagg_bench(path: str = DISAGG_BENCH) -> int:
+    try:
+        with open(path) as f:
+            p = json.load(f)
+    except OSError as e:
+        return _fail(f"cannot read {os.path.relpath(path, REPO)}: {e}")
+    except ValueError as e:
+        return _fail(f"{os.path.relpath(path, REPO)} is not JSON: {e}")
+    rc = 0
+    if p.get("bench") != "disagg":
+        rc |= _fail(f"bench must be 'disagg', got {p.get('bench')!r}")
+    for key in ("config", "colo", "disagg", "parity", "acceptance"):
+        if not isinstance(p.get(key), dict):
+            rc |= _fail(f"missing/non-object section {key!r}")
+    if rc:
+        return rc
+    if "device_kind" not in p or "comm_plan_digest" not in p:
+        rc |= _fail("payload lacks the PR 7/PR 9 device_kind/"
+                    "comm_plan_digest stamps")
+    rows = dict(p["colo"])
+    rows["disagg"] = p["disagg"]
+    for name, row in rows.items():
+        if not isinstance(row, dict):
+            rc |= _fail(f"arm {name!r} must be an object")
+            continue
+        for k in ("victim_max_gap_ms", "goodput_toks_per_s"):
+            if not _num(row.get(k)):
+                rc |= _fail(f"{name}.{k} must be numeric")
+        if not isinstance(row.get("victim_tpot"), dict) \
+                or not _num(row["victim_tpot"].get("p95_ms")):
+            rc |= _fail(f"{name}.victim_tpot.p95_ms missing")
+        if row.get("reconciliation_ok") is not True:
+            rc |= _fail(f"{name}.reconciliation_ok must be true")
+    for k in ("migrations", "migrated_bytes", "routes"):
+        if not _num(p["disagg"].get(k)):
+            rc |= _fail(f"disagg.{k} must be numeric")
+    if rc:
+        return rc
+    acc = p["acceptance"]
+    for k in _DISAGG_ACCEPTANCE:
+        if acc.get(k) is not True:
+            rc |= _fail(f"acceptance.{k} must be true (got {acc.get(k)!r})"
+                        f" — the committed evidence no longer shows the "
+                        f"win; re-run serve-bench --disagg")
+    base = rows.get(acc.get("baseline_arm") or "")
+    if not isinstance(base, dict):
+        rc |= _fail(f"acceptance.baseline_arm {acc.get('baseline_arm')!r}"
+                    f" names no recorded colo arm")
+        return rc
+    # cross-checks: booleans must agree with the rows they summarize
+    dis = p["disagg"]
+    if not (dis["victim_max_gap_ms"] < base["victim_max_gap_ms"]
+            and dis["victim_tpot"]["p95_ms"]
+            < base["victim_tpot"]["p95_ms"]):
+        rc |= _fail("victim_stall_better/tpot_p95_better contradict "
+                    "the recorded baseline-arm rows")
+    chunked = [v for k, v in p["colo"].items() if k != "chunk0"]
+    if chunked and not all(dis["goodput_toks_per_s"]
+                           >= r["goodput_toks_per_s"] for r in chunked):
+        rc |= _fail("goodput_no_worse contradicts the recorded "
+                    "chunked-arm goodputs")
+    if not (p["parity"].get("prefix_on") is True
+            and p["parity"].get("prefix_off") is True):
+        rc |= _fail("tokens_bit_identical contradicts the parity rows")
+    if rc == 0:
+        print(f"check_gen_artifacts: "
+              f"{os.path.relpath(path, REPO)} OK "
+              f"(stall {dis['victim_max_gap_ms']} < "
+              f"{base['victim_max_gap_ms']} ms vs "
+              f"{acc['baseline_arm']}, goodput "
+              f"{dis['goodput_toks_per_s']} tok/s, "
+              f"{dis['migrations']} migrations)")
+    return rc
+
+
 def check_pallas_decisions() -> int:
     rc = 0
     paths = sorted(glob.glob(os.path.join(REPO, "artifacts",
@@ -238,6 +328,7 @@ def main(argv=None) -> int:
         return check_pallas_decisions()
     rc = check_prefix_bench()
     rc |= check_spec_bench()
+    rc |= check_disagg_bench()
     rc |= check_pallas_decisions()
     return rc
 

@@ -40,6 +40,13 @@ declare -a cases=(
   # untouched, and a held publish must land exactly at the pinned
   # dispatch boundary (docs/serving.md "Model fleets")
   "$FAST_TIMEOUT tests/test_fleet.py::TestFleetFaults"
+  # migrate_fail_at / route_host_down: the disaggregated-router fault
+  # kinds — a failed KV migration handoff must fall back to co-located
+  # decode with the exact same tokens (one serve_health event, zero
+  # streams fail), a downed host must drain its queued requests to
+  # survivors, and the page pools must drain to zero on BOTH engines
+  # after every case (docs/serving.md "Disaggregated prefill/decode")
+  "$FAST_TIMEOUT tests/test_cluster.py::TestRouterFaults"
   # flight recorder under faults (docs/observability.md): an injected
   # serve_fail_dispatch must leave a dump in FF_FLIGHT_DIR naming the
   # failed dispatch and retaining its request spans; a health edge
