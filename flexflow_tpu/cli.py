@@ -104,7 +104,8 @@ def main(argv=None) -> None:
               "       flexflow-tpu trace export RAW.json [--out f.json]\n"
               "       flexflow-tpu flight dump|show [--dir D]\n"
               "flags (reference model.cc:1221-1289): -e -b --lr --wd -d "
-              "--budget --alpha --reshard-budget -s/-import -ll:tpu "
+              "--budget --alpha --search-mode --best-known "
+              "--reshard-budget -s/-import -ll:tpu "
               "-ll:cpu --nodes --profiling --seed --remat "
               "--steps-per-dispatch --pad-tail --calibration "
               "--cost-estimator "

@@ -174,6 +174,17 @@ class FFConfig:
     # OFF by default: the proposal distribution (and therefore every
     # acceptance decision) is bit-identical to a build without the axis.
     search_precision: bool = False
+    # --search-mode: "mcmc" (the pure anneal, the historical default —
+    # fixed-seed bit-identical across releases) or "hybrid" (ISSUE 20:
+    # exact DP over decomposable subgraphs + cost-guided MCMC on the
+    # residual cross-region variables, docs/strategy_search.md "Exact
+    # DP on decomposable subgraphs")
+    search_mode: str = "mcmc"
+    # --best-known: on-disk BestStrategyStore JSON for warm-started
+    # transfer — seeds the search from the best prior strategy recorded
+    # for the same graph digest/device count/estimator, and records the
+    # winner back when it improves on the stored entry
+    best_known_file: str = ""
     # --reshard-budget: MCMC iterations for the IN-THE-LOOP re-search an
     # elastic reshard point runs (FFModel.reshard / reshard-on-resume,
     # docs/elastic.md "Resharding").  None = reuse search_budget; the
@@ -495,6 +506,14 @@ class FFConfig:
                 cfg.search_chains = max(1, int(val()))
             elif a == "--search-precision":
                 cfg.search_precision = True
+            elif a == "--search-mode":
+                mode = val().lower()
+                if mode not in ("mcmc", "hybrid"):
+                    raise ValueError(
+                        f"--search-mode {mode!r}: want 'mcmc' or 'hybrid'")
+                cfg.search_mode = mode
+            elif a == "--best-known":
+                cfg.best_known_file = val()
             elif a == "--reshard-budget":
                 cfg.reshard_search_budget = int(val())
             elif a == "--calibration":

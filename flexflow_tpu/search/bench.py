@@ -49,7 +49,62 @@ def _dlrm_layers():
     return model.layers
 
 
-GRAPHS = {"transformer": _transformer_layers, "dlrm": _dlrm_layers}
+def _inception_layers():
+    """InceptionV3 at calibration-zoo scale (image_size=75, see
+    ``calibration._zoo_inception``): the reconvergent-diamond stress
+    test for the hybrid decomposition pass."""
+    from ..models.inception import build_inception_v3
+    cfg = FFConfig(batch_size=2, compute_dtype="float32")
+    model, _, _ = build_inception_v3(cfg, image_size=75)
+    return model.layers
+
+
+def _mlp_layers():
+    """A pure dense chain — fully decomposable, so ``mode="hybrid"``
+    must return the exact DP solution with ZERO MCMC proposals (the
+    ISSUE 20 acceptance gate)."""
+    from ..model import FFModel
+    cfg = FFConfig(batch_size=4096, compute_dtype="float32")
+    cfg.mesh_shape = {"n": 1}
+    model = FFModel(cfg)
+    t = model.create_tensor((4096, 256))
+    t = model.dense(t, 256, activation="relu")
+    t = model.dense(t, 256, activation="relu")
+    t = model.dense(t, 16)
+    return model.layers
+
+
+GRAPHS = {"transformer": _transformer_layers, "dlrm": _dlrm_layers,
+          "inception": _inception_layers, "mlp": _mlp_layers}
+
+# the three real zoo models the hybrid-vs-mcmc acceptance gate scores
+# (mlp is the fully-decomposable control, not a zoo model)
+ZOO_MODELS = ("transformer", "dlrm", "inception")
+
+
+def _convergence_stamps(stats: Dict) -> Dict:
+    """Convergence stamps for one search arm, derived from the
+    ``stats`` dict ``mcmc.search``/``hybrid.run_hybrid`` fill:
+    wall-clock to the final best, Metropolis acceptance rate, and the
+    first proposal index whose best-so-far is within 1% of the final
+    best (how quickly the walk got 'close')."""
+    proposals = int(stats.get("proposals", 0))
+    accepted = int(stats.get("accepted", 0))
+    trace = stats.get("best_trace") or []
+    within = None
+    if trace:
+        final = trace[-1][1]
+        if final == final and final != float("inf"):
+            for p, t in trace:
+                if t <= final * 1.01:
+                    within = int(p)
+                    break
+    return {
+        "time_to_best_ms": round(float(stats.get("time_to_best_ms", 0.0)), 3),
+        "acceptance_rate": (round(accepted / proposals, 4)
+                            if proposals else None),
+        "proposals_to_within_1pct": within,
+    }
 
 
 def _proposal_sequence(layers, num_devices: int, steps: int, seed: int
@@ -82,13 +137,17 @@ def _proposal_sequence(layers, num_devices: int, steps: int, seed: int
 
 def bench_graph(name: str, num_devices: int = 16, steps: int = 192,
                 budget: int = 200, seed: int = 0,
-                min_time_s: float = 0.4, estimator=None) -> Dict:
+                min_time_s: float = 0.4, estimator=None,
+                hybrid: bool = False) -> Dict:
     """Delta-vs-full proposals/sec + best simulated time for one graph.
     ``estimator`` (a ``search.calibration.CostEstimator``) makes both
     paths — and the short real search — run on the calibrated
     objective; the row records which estimator/calibration produced it
     so artifacts stay comparable across machines and calibration
-    states."""
+    states.  ``hybrid=True`` adds a ``mode="hybrid"`` arm at HALF the
+    proposal budget (the ISSUE 20 gate: exact DP + guided residual
+    anneal should match or beat the pure anneal on half the
+    proposals)."""
     from ..profiling import time_calls
     from .mcmc import search
     from .simulator import Simulator
@@ -118,13 +177,14 @@ def bench_graph(name: str, num_devices: int = 16, steps: int = 192,
     stats = session.stats()
     session.close()
 
+    search_stats: Dict = {}
     best, best_mesh, best_t = search(layers, num_devices, budget=budget,
-                                     seed=seed, sim=sim)
+                                     seed=seed, sim=sim, stats=search_stats)
     from ..config import dtype_short as _dtype_short
     from .calibration import device_kind as _device_kind
     desc = (estimator.describe() if estimator is not None
             else {"estimator": "analytic", "calibration_digest": None})
-    return {
+    row = {
         "graph": name,
         "num_ops": len(layers),
         "num_devices": num_devices,
@@ -144,7 +204,114 @@ def bench_graph(name: str, num_devices: int = 16, steps: int = 192,
         "best_simulated_ms": (None if best_t != best_t or best_t == float("inf")
                               else round(best_t * 1e3, 6)),
         "best_mesh": {a: s for a, s in best_mesh.items() if s > 1},
+        # convergence stamps (ISSUE 20): ride next to the
+        # device_kind/calibration_digest provenance stamps so arms
+        # stay comparable across machines and calibration states
+        **_convergence_stamps(search_stats),
     }
+    if hybrid:
+        hstats: Dict = {}
+        hybrid_budget = max(1, budget // 2)
+        hbest, hmesh, ht = search(layers, num_devices,
+                                  budget=hybrid_budget, seed=seed,
+                                  sim=sim, mode="hybrid", stats=hstats)
+        hybrid_ms = (None if ht != ht or ht == float("inf")
+                     else round(ht * 1e3, 6))
+        row["hybrid"] = {
+            "search_budget": hybrid_budget,
+            "best_simulated_ms": hybrid_ms,
+            "best_mesh": {a: s for a, s in hmesh.items() if s > 1},
+            "regions": hstats.get("regions", 0),
+            "exact_ops": hstats.get("exact_ops", 0),
+            "residual_ops": hstats.get("residual_ops", 0),
+            "fully_decomposable": bool(hstats.get("fully_decomposable")),
+            "proposals": int(hstats.get("proposals", 0)),
+            "proposals_saved": int(hstats.get("proposals_saved", 0)),
+            "beats_mcmc": (hybrid_ms is not None
+                           and row["best_simulated_ms"] is not None
+                           and hybrid_ms <= row["best_simulated_ms"]),
+            **_convergence_stamps(hstats),
+        }
+    return row
+
+
+def hybrid_acceptance(results: List[Dict]) -> Dict:
+    """The ISSUE 20 acceptance booleans, computed from hybrid-arm rows:
+    hybrid final cost must be <= the MCMC-only arm (which ran at TWICE
+    the proposal budget) on >= 2 of the 3 zoo models, and every
+    fully-decomposable graph must have spent zero proposals."""
+    zoo = [r for r in results if r["graph"] in ZOO_MODELS and "hybrid" in r]
+    wins = [r["graph"] for r in zoo if r["hybrid"]["beats_mcmc"]]
+    decomp = [r for r in results
+              if "hybrid" in r and r["hybrid"]["fully_decomposable"]]
+    return {
+        "zoo_models_compared": [r["graph"] for r in zoo],
+        "hybrid_le_mcmc_models": wins,
+        "hybrid_le_mcmc_at_half_budget": len(wins) >= min(2, len(zoo)),
+        "fully_decomposable_graphs": [r["graph"] for r in decomp],
+        "fully_decomposable_zero_proposals": (
+            bool(decomp)
+            and all(r["hybrid"]["proposals"] == 0 for r in decomp)),
+    }
+
+
+_ROW_KEYS = ("graph", "num_devices", "device_kind", "precision_policy",
+             "estimator", "search_budget", "best_simulated_ms",
+             "time_to_best_ms", "acceptance_rate",
+             "proposals_to_within_1pct")
+_HYBRID_KEYS = ("search_budget", "best_simulated_ms", "regions",
+                "exact_ops", "residual_ops", "fully_decomposable",
+                "proposals", "beats_mcmc", "time_to_best_ms",
+                "acceptance_rate", "proposals_to_within_1pct")
+
+
+def validate_hybrid_bench(data) -> List[str]:
+    """Schema check for the committed ``search_hybrid_r20.json``
+    artifact (run by ``scripts/check_strategy_artifacts.py`` in CI).
+    Returns a list of problems; empty means valid."""
+    errs: List[str] = []
+    if not isinstance(data, dict):
+        return ["payload is not an object"]
+    if data.get("kind") != "search_hybrid_bench":
+        errs.append(f"kind {data.get('kind')!r} != 'search_hybrid_bench'")
+    rows = data.get("results")
+    if not isinstance(rows, list) or not rows:
+        return errs + ["results missing or empty"]
+    for i, row in enumerate(rows):
+        if not isinstance(row, dict):
+            errs.append(f"results[{i}] is not an object")
+            continue
+        for k in _ROW_KEYS:
+            if k not in row:
+                errs.append(f"results[{i}] missing {k!r}")
+        if "calibration_digest" not in row:
+            errs.append(f"results[{i}] missing 'calibration_digest'")
+        hyb = row.get("hybrid")
+        if not isinstance(hyb, dict):
+            errs.append(f"results[{i}] missing hybrid arm")
+            continue
+        for k in _HYBRID_KEYS:
+            if k not in hyb:
+                errs.append(f"results[{i}].hybrid missing {k!r}")
+        if not isinstance(hyb.get("proposals"), int) or \
+                hyb.get("proposals", 0) < 0:
+            errs.append(f"results[{i}].hybrid.proposals not a "
+                        "non-negative int")
+        if isinstance(row.get("search_budget"), int) and \
+                isinstance(hyb.get("search_budget"), int) and \
+                hyb["search_budget"] * 2 > row["search_budget"]:
+            errs.append(f"results[{i}]: hybrid budget "
+                        f"{hyb['search_budget']} exceeds half the mcmc "
+                        f"budget {row['search_budget']}")
+    acc = data.get("acceptance")
+    if not isinstance(acc, dict):
+        errs.append("acceptance block missing")
+    else:
+        for k in ("hybrid_le_mcmc_at_half_budget",
+                  "fully_decomposable_zero_proposals"):
+            if not isinstance(acc.get(k), bool):
+                errs.append(f"acceptance.{k} missing or not a bool")
+    return errs
 
 
 def main(argv=None) -> None:
@@ -170,6 +337,10 @@ def main(argv=None) -> None:
     ap.add_argument("--estimator", default="",
                     help="cost estimator (table|ridge; default table "
                          "when --calibration is given, else analytic)")
+    ap.add_argument("--hybrid", action="store_true",
+                    help="add a mode=hybrid arm at HALF --budget per "
+                         "graph and emit the ISSUE 20 acceptance "
+                         "booleans (payload kind search_hybrid_bench)")
     ap.add_argument("--out", default="",
                     help="also write the JSON artifact here")
     args = ap.parse_args(argv)
@@ -193,9 +364,13 @@ def main(argv=None) -> None:
                                    table)
     results = [bench_graph(g, num_devices=args.devices, steps=args.steps,
                            budget=args.budget, seed=args.seed,
-                           min_time_s=args.min_time, estimator=estimator)
+                           min_time_s=args.min_time, estimator=estimator,
+                           hybrid=args.hybrid)
                for g in names]
     payload = {"bench": "search-bench", "results": results}
+    if args.hybrid:
+        payload["kind"] = "search_hybrid_bench"
+        payload["acceptance"] = hybrid_acceptance(results)
     text = json.dumps(payload, indent=2)
     print(text)
     if args.out:

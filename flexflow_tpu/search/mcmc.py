@@ -179,7 +179,9 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
            estimator=_UNSET,
            sim: Optional[Simulator] = None, chains: int = 1,
            fixed_mesh: Optional[MeshShape] = None,
-           precision_axis: bool = False
+           precision_axis: bool = False, mode: str = "mcmc",
+           warm_start: str = "",
+           stats: Optional[Dict] = None
            ) -> Tuple[Dict[str, ParallelConfig], MeshShape, float]:
     """Run the annealing loop; returns (best strategies, best mesh
     factorization, best simulated time).  ``devices_per_slice`` < the
@@ -212,7 +214,22 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
     rejects), and partitioning mutations carry the op's current
     precision along.  OFF by default: the rng draw sequence — and
     therefore every acceptance decision — is bit-identical to a build
-    without the axis."""
+    without the axis.
+
+    ``mode`` selects the search driver (ISSUE 20): ``"mcmc"`` — the
+    default — is this annealing loop, bit-identical under a fixed seed
+    to every prior build (the rng draw sequence is untouched);
+    ``"hybrid"`` solves decomposable regions EXACTLY first
+    (search/decompose.py Viterbi DP over ``legal_configs``, scored with
+    this same simulator) and anneals only the residual cross-region
+    variables with a cost-model-guided proposal distribution
+    (search/hybrid.py).  ``warm_start`` names an on-disk
+    :class:`~flexflow_tpu.search.hybrid.BestStrategyStore` the hybrid
+    driver seeds from and updates.  ``stats``, when a dict, is filled
+    with search telemetry in either mode: ``proposals``, ``accepted``,
+    ``evaluations``, ``best_trace`` ([(proposal #, best simulated
+    time)]), ``time_to_best_ms`` — counters only, never an rng draw,
+    so passing it cannot change the result."""
     # one (name, value) table serves both branches: the contradiction
     # check against a shared sim AND the pass-through construction —
     # a new Simulator-mirrored kwarg is added in exactly one place
@@ -279,6 +296,22 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
     devices_per_slice = sim.devices_per_slice
     compute_dtype, conv_layout = sim.compute_dtype, sim.conv_layout
     opt_slot_bytes = sim.opt_slot_bytes
+    if mode not in ("mcmc", "hybrid"):
+        raise ValueError(f"unknown search mode {mode!r} "
+                         "(want 'mcmc' or 'hybrid')")
+    if mode == "hybrid":
+        # the hybrid driver receives the fully-resolved simulator, so
+        # the DP, the guided anneal and this MCMC path share ONE
+        # objective (estimator, spec, sparse tables, dtype — all of it)
+        from .hybrid import run_hybrid
+        return run_hybrid(
+            layers, num_devices, budget, alpha, seed, sim,
+            overlap_backward_update=overlap_backward_update,
+            chains=chains, fixed_mesh=fixed_mesh,
+            precision_axis=precision_axis, verbose=verbose,
+            warm_start=warm_start, stats=stats)
+    import time as _time
+    wall0 = _time.perf_counter()
     if fixed_mesh is not None:
         pinned = {a: int(fixed_mesh.get(a, 1)) for a in AXES}
         if _prod(pinned.values()) != num_devices:
@@ -353,6 +386,29 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
                                 mesh_shape=mesh_shape)
     best, best_mesh, best_time = dict(current), dict(mesh_shape), cur_time
 
+    # ISSUE 20 bugfix: when no proposal can possibly change anything —
+    # a single candidate mesh (no refactorization moves), no precision
+    # axis, and every op's legal_configs a singleton — the anneal would
+    # burn the full budget on no-op draws (every single-op proposal
+    # hits the ``dims == cur`` skip).  Return the multi-start optimum
+    # directly — the exact same result, zero evaluations — and log the
+    # savings.
+    if (budget > 0 and len(meshes) == 1 and not precision_axis
+            and all(len(cands(op, meshes[0])) <= 1 for op in layers)):
+        from ..fflogger import get_logger
+        get_logger("search").info(
+            "search: every op has a single legal config on the only "
+            "mesh factorization — annealing skipped, "
+            f"{budget * max(1, chains)} proposals saved")
+        if stats is not None:
+            stats.update({
+                "mode": "mcmc", "proposals": 0, "accepted": 0,
+                "evaluations": 0,
+                "proposals_saved": budget * max(1, chains),
+                "best_trace": [(0, best_time)],
+                "time_to_best_ms": (_time.perf_counter() - wall0) * 1e3})
+        return best, best_mesh, best_time
+
     def run_chain(chain_idx: int):
         """One independent anneal from the shared multi-start seed.
         Chain 0 draws from ``Random(seed)`` so the single-chain walk (and
@@ -364,6 +420,13 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
         cur, cur_t = dict(current), cur_time
         ms_cur = dict(mesh_shape)
         b, bm, bt = dict(cur), dict(ms_cur), cur_t
+        # bench instrumentation (ISSUE 20): proposals actually evaluated,
+        # Metropolis acceptances, the (proposal#, best-so-far) trace and
+        # the wall clock of the last improvement — pure counters, no rng
+        # draws, so the walk is bit-identical with or without them
+        proposals = accepted = 0
+        trace = [(0, bt)]
+        t_best = _time.perf_counter() - wall0
         session = sim.session(layers, overlap_backward_update,
                               mesh_shape=ms_cur)
         try:
@@ -408,6 +471,7 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
                     proposal = dict(cur)
                     proposal[op.name] = new_cfg
                     prop_mesh = ms_cur
+                proposals += 1
                 new_time = session.evaluate(proposal, mesh_shape=prop_mesh)
                 delta = new_time - cur_t
                 # inf -> inf moves are accepted unconditionally: when the
@@ -422,14 +486,18 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
                         (math.isfinite(new_time) and
                          rng.random() < math.exp(-alpha * delta * 1e3)):
                     cur, cur_t, ms_cur = proposal, new_time, prop_mesh
+                    accepted += 1
                     if cur_t < bt:
                         b, bm, bt = dict(cur), dict(ms_cur), cur_t
+                        trace.append((proposals, bt))
+                        t_best = _time.perf_counter() - wall0
                         if verbose:
                             print(f"[search] chain {chain_idx} iter {it}: "
                                   f"{bt * 1e3:.3f} ms")
         finally:
+            evals = session.evaluations
             session.close()
-        return bt, chain_idx, b, bm
+        return bt, chain_idx, b, bm, proposals, accepted, trace, t_best, evals
 
     chains = max(1, chains)
     if chains == 1 or measure:
@@ -443,9 +511,20 @@ def search(layers: List[Op], num_devices: int, budget: int = 1000,
                 max_workers=min(chains, _os.cpu_count() or 1)) as ex:
             results = list(ex.map(run_chain, range(chains)))
     # deterministic reduce: best simulated time, ties to the lowest chain
-    bt, _, b, bm = min(results, key=lambda r: (r[0], r[1]))
+    win = min(results, key=lambda r: (r[0], r[1]))
+    bt, win_chain, b, bm = win[0], win[1], win[2], win[3]
     if bt < best_time:
         best, best_mesh, best_time = b, bm, bt
+    if stats is not None:
+        stats.update({
+            "mode": "mcmc",
+            "proposals": sum(r[4] for r in results),
+            "accepted": sum(r[5] for r in results),
+            "evaluations": sum(r[8] for r in results),
+            "best_trace": list(win[6]),
+            "time_to_best_ms": win[7] * 1e3,
+            "winning_chain": win_chain,
+        })
     return best, best_mesh, best_time
 
 
@@ -524,7 +603,9 @@ def optimize_strategies(model, cfg: FFConfig, num_devices: int = None,
         compute_dtype=cfg.compute_dtype, conv_layout=layout,
         opt_slot_bytes=slot_bytes, sparse_tables=sparse_tables,
         chains=cfg.search_chains, fixed_mesh=mesh_shape,
-        precision_axis=cfg.search_precision, **extra)
+        precision_axis=cfg.search_precision,
+        mode=getattr(cfg, "search_mode", "mcmc"),
+        warm_start=getattr(cfg, "best_known_file", ""), **extra)
     calib_note = (f", estimator {est.name} "
                   f"(calibration {calib_table.digest})"
                   if est is not None and calib_table is not None else "")

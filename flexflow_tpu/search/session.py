@@ -298,6 +298,9 @@ class SimSession:
         self._stale: set = set()        # ops whose plan row needs refresh
         self._pending: Dict[int, Tuple] = {}   # op idx -> engine row
         self._idx_of = {op.name: i for i, op in enumerate(self.layers)}
+        # total evaluate() calls — the per-chain proposal-throughput
+        # denominator the bench/hybrid stats stamp (ISSUE 20)
+        self.evaluations = 0
         self._first = True
         self._handle = None
         self._py = None
@@ -427,6 +430,7 @@ class SimSession:
                  mesh_shape: Optional[Dict[str, int]] = None) -> float:
         """Simulated iteration time of ``strategies`` — bit-identical to
         ``sim.simulate(layers, strategies, overlap, mesh_shape)``."""
+        self.evaluations += 1
         sim = self.sim
         if mesh_shape is not None and mesh_shape != self.mesh_shape:
             # stack degrees (e/p) feed the memory model only; drop the
@@ -495,6 +499,9 @@ class SimSession:
         if self._handle is not None:
             names = ("edge_rebuilds", "full_replays", "delta_repairs",
                      "repair_fallbacks", "tasks", "assemblies")
-            return {n: int(self._lib.ffsim_stat(self._handle, i))
-                    for i, n in enumerate(names)}
-        return self._py.stats()
+            out = {n: int(self._lib.ffsim_stat(self._handle, i))
+                   for i, n in enumerate(names)}
+        else:
+            out = self._py.stats()
+        out["evaluations"] = self.evaluations
+        return out

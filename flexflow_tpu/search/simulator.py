@@ -288,6 +288,32 @@ class Simulator:
         self._plan_cache[key] = plan
         return plan
 
+    def op_time_shares(self, layers: List[Op], strategies,
+                       subset: Optional[List[str]] = None
+                       ) -> Dict[str, float]:
+        """Each op's share of the summed per-op time (fwd + bwd + sync
+        from ``_op_plan``) under ``strategies`` — the cost-model signal
+        the hybrid search's guided proposal distribution mutates by
+        (search/hybrid.py): ops that dominate the simulated step get
+        proposed proportionally more often.  ``subset`` restricts the
+        normalization to those op names (the MCMC residual).  Non-finite
+        plans contribute zero; an all-zero vector degrades to uniform so
+        the caller's distribution is always proper."""
+        names = subset if subset is not None else [op.name for op in layers]
+        wanted = set(names)
+        raw: Dict[str, float] = {}
+        for op in layers:
+            if op.name not in wanted:
+                continue
+            _, _, ft, bt, sync = self._op_plan(op, strategies)
+            t = ft + bt + sync
+            raw[op.name] = t if math.isfinite(t) and t > 0 else 0.0
+        total = sum(raw.values())
+        if total <= 0:
+            u = 1.0 / max(1, len(raw))
+            return {n: u for n in raw}
+        return {n: v / total for n, v in raw.items()}
+
     def peak_memory_bytes(self, layers: List[Op],
                           strategies: Dict[str, ParallelConfig],
                           mesh_shape: Optional[Dict[str, int]] = None,

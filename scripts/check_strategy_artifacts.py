@@ -44,13 +44,76 @@ def _run_json(main, argv):
     return rc, payload, []
 
 
+def _check_hybrid_bench(problems) -> None:
+    """ISSUE 20 CI satellite: the committed hybrid-search evidence must
+    stay schema-valid AND its acceptance booleans must hold — hybrid
+    matched/beat the pure anneal at half budget on >= 2 of 3 zoo
+    models, and the fully-decomposable control spent zero proposals."""
+    from flexflow_tpu.search.bench import validate_hybrid_bench
+
+    rel = "artifacts/search_hybrid_r20.json"
+    path = os.path.join(REPO, rel)
+    if not os.path.exists(path):
+        problems.append(f"{rel}: missing (ISSUE 20 evidence artifact)")
+        return
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except ValueError as e:
+        problems.append(f"{rel}: not JSON: {e}")
+        return
+    for p in validate_hybrid_bench(data):
+        problems.append(f"{rel}: schema: {p}")
+    acc = data.get("acceptance")
+    if isinstance(acc, dict):
+        for k in ("hybrid_le_mcmc_at_half_budget",
+                  "fully_decomposable_zero_proposals"):
+            if acc.get(k) is not True:
+                problems.append(
+                    f"{rel}: acceptance.{k} is {acc.get(k)!r}, not True "
+                    f"— the hybrid search no longer meets its gate")
+
+
+def _discover_extra_cases(problems):
+    """Any committed ``artifacts/searched_*.pb`` beyond CASES gets
+    linted too (ISSUE 20): a new searched strategy must either match
+    the ``searched_<model>_b<batch>_<n>dev[...].pb`` naming (model
+    inferable -> full lint ride-along) or be added to CASES
+    explicitly — never silently skipped."""
+    import glob
+    import re
+
+    known = {rel for rel, _, _ in CASES}
+    lint_model = {"transformer": "transformer", "inception_v3": "inception",
+                  "nmt": "nmt"}
+    extras = []
+    for path in sorted(glob.glob(os.path.join(REPO, "artifacts",
+                                              "searched_*.pb"))):
+        rel = os.path.relpath(path, REPO)
+        if rel in known:
+            continue
+        m = re.match(r"searched_(?P<model>.+?)_b(?P<batch>\d+)_"
+                     r"(?P<ndev>\d+)dev.*\.pb$", os.path.basename(path))
+        if m and m.group("model") in lint_model:
+            extras.append((rel, lint_model[m.group("model")],
+                           int(m.group("batch"))))
+        else:
+            problems.append(
+                f"{rel}: committed searched strategy not covered by the "
+                f"artifact gate — rename to searched_<model>_b<batch>_"
+                f"<n>dev.pb or add it to CASES")
+    return extras
+
+
 def main() -> int:
     from flexflow_tpu.analysis import (validate_explain_json,
                                        validate_report_json)
     from flexflow_tpu.cli import explain_main, lint_main
 
     problems = []
-    for rel, model, batch in CASES:
+    _check_hybrid_bench(problems)
+    cases = CASES + _discover_extra_cases(problems)
+    for rel, model, batch in cases:
         path = os.path.join(REPO, rel)
         if not os.path.exists(path):
             problems.append(f"{rel}: missing (listed in "
@@ -106,8 +169,8 @@ def main() -> int:
         print(f"check_strategy_artifacts: {len(problems)} finding(s)",
               file=sys.stderr)
         return 1
-    print(f"check_strategy_artifacts: {len(CASES)} shipped strategies "
-          f"lint + explain clean")
+    print(f"check_strategy_artifacts: {len(cases)} shipped strategies "
+          f"lint + explain clean, hybrid-search evidence gate holds")
     return 0
 
 

@@ -41,8 +41,9 @@ if not MEASURE:
     jax.config.update("jax_platforms", "cpu")
 
 import flexflow_tpu as ff  # noqa: E402
-from flexflow_tpu.config import ParallelConfig  # noqa: E402
 from flexflow_tpu.search.cost_model import V5E_SPEC  # noqa: E402
+from flexflow_tpu.search.decompose import (  # noqa: E402
+    data_parallel_strategies as dp_strategies)
 from flexflow_tpu.search.mcmc import search  # noqa: E402
 from flexflow_tpu.search.simulator import Simulator  # noqa: E402
 from flexflow_tpu.strategy.proto import save_strategy_file  # noqa: E402
@@ -82,12 +83,6 @@ CONFIGS = [
     ("transformer", 8, 8),
     ("nmt", 256, 8),
 ]
-
-
-def dp_strategies(layers, ndev):
-    return {op.name: ParallelConfig.data_parallel(
-        min(ndev, op.outputs[0].shape[0]), op.outputs[0].num_dims)
-        for op in layers}
 
 
 def main():

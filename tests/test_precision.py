@@ -46,9 +46,8 @@ def _zoo_transformer(batch=8, **kw):
 
 
 def _dp_strategy(model, ndev=4):
-    return {op.name: ParallelConfig.data_parallel(
-        min(ndev, op.outputs[0].shape[0]), op.outputs[0].num_dims)
-        for op in model.layers}
+    from flexflow_tpu.search.decompose import data_parallel_strategies
+    return data_parallel_strategies(model.layers, ndev)
 
 
 # ---------------------------------------------------------------------

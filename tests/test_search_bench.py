@@ -62,3 +62,60 @@ def test_cli_search_bench_smoke(tmp_path):
     assert result["proposals_per_sec_delta"] > 0
     assert result["proposals_per_sec_full"] > 0
     assert json.loads(out.read_text()) == payload
+
+
+def test_bench_row_convergence_stamps():
+    """ISSUE 20: rows stamp time_to_best_ms / acceptance_rate /
+    proposals_to_within_1pct next to the provenance stamps, for both
+    the mcmc and (when requested) hybrid arms."""
+    r = bench_graph("dlrm", num_devices=8, steps=16, budget=20,
+                    min_time_s=0.05, hybrid=True)
+    json.dumps(r)
+    assert r["time_to_best_ms"] >= 0
+    assert r["acceptance_rate"] is None or 0 <= r["acceptance_rate"] <= 1
+    assert (r["proposals_to_within_1pct"] is None
+            or r["proposals_to_within_1pct"] >= 0)
+    hyb = r["hybrid"]
+    assert hyb["search_budget"] == 10  # half the mcmc budget
+    assert hyb["time_to_best_ms"] >= 0
+    assert isinstance(hyb["proposals"], int) and hyb["proposals"] >= 0
+    assert hyb["exact_ops"] + hyb["residual_ops"] == r["num_ops"]
+    assert isinstance(hyb["beats_mcmc"], bool)
+
+
+def test_hybrid_bench_payload_validates():
+    """The in-process payload round-trips through the CI schema gate,
+    and the fully-decomposable control graph reports zero proposals."""
+    from flexflow_tpu.search.bench import (hybrid_acceptance,
+                                           validate_hybrid_bench)
+    rows = [bench_graph(g, num_devices=8, steps=12, budget=10,
+                        min_time_s=0.05, hybrid=True)
+            for g in ("mlp", "dlrm")]
+    payload = {"bench": "search-bench", "kind": "search_hybrid_bench",
+               "results": rows, "acceptance": hybrid_acceptance(rows)}
+    assert validate_hybrid_bench(payload) == []
+    mlp = rows[0]
+    assert mlp["hybrid"]["fully_decomposable"]
+    assert mlp["hybrid"]["proposals"] == 0
+    assert payload["acceptance"]["fully_decomposable_zero_proposals"]
+    # schema errors are actually detected, not vacuously absent
+    broken = json.loads(json.dumps(payload))
+    del broken["results"][0]["hybrid"]["proposals"]
+    broken["kind"] = "wrong"
+    assert len(validate_hybrid_bench(broken)) >= 2
+
+
+def test_committed_hybrid_artifact_gate():
+    """The committed ISSUE 20 evidence must stay schema-valid and its
+    acceptance booleans must hold (the same check CI runs via
+    scripts/check_strategy_artifacts.py)."""
+    from flexflow_tpu.search.bench import validate_hybrid_bench
+    path = os.path.join(os.path.dirname(__file__), "..", "artifacts",
+                        "search_hybrid_r20.json")
+    with open(path) as f:
+        data = json.load(f)
+    assert validate_hybrid_bench(data) == []
+    acc = data["acceptance"]
+    assert acc["hybrid_le_mcmc_at_half_budget"] is True
+    assert acc["fully_decomposable_zero_proposals"] is True
+    assert len(acc["hybrid_le_mcmc_models"]) >= 2

@@ -20,9 +20,11 @@ from flexflow_tpu.search.simulator import Simulator
 
 
 def _dp(layers, ndev):
-    return {op.name: ParallelConfig.data_parallel(
-        min(ndev, op.outputs[0].shape[0]), op.outputs[0].num_dims)
-        for op in layers}
+    # the shared baseline definition (ISSUE 20 dedup): the script and
+    # this test must score the SAME dp strategy or the artifact claims
+    # drift from what the script actually compared against
+    from flexflow_tpu.search.decompose import data_parallel_strategies
+    return data_parallel_strategies(layers, ndev)
 
 
 def _nmt_model(batch=256, vocab=20000, dim=2048):
